@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Driver benchmark: one JSON line with the headline metric.
+
+Metric: steady-state decode throughput (tokens/sec/chip) for a ~1B-class
+Llama-3-style model in bfloat16 on the available chip(s) — the largest of
+the BASELINE.json model family that fits a single v5e chip's HBM with
+random weights. No published reference numbers exist (BASELINE.md: the
+reference is an unimplemented scaffold), so `vs_baseline` is the ratio to
+the first recorded run of this same benchmark (bench_baseline.json,
+committed after round 1) — i.e. it tracks our own improvement.
+"""
+import json
+import sys
+from pathlib import Path
+
+BASELINE_FILE = Path(__file__).parent / "bench_baseline.json"
+
+
+def main() -> int:
+    import jax
+    from butterfly_tpu.core.config import ModelConfig
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.obs.benchmark import run_decode_benchmark
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    if on_tpu:
+        # ~1.2B params: fits one v5e chip (16 GiB HBM) in bf16 with cache.
+        cfg = ModelConfig(arch="llama", vocab_size=32000, hidden_size=2048,
+                          num_layers=16, num_heads=16, num_kv_heads=8,
+                          head_dim=128, intermediate_size=5632,
+                          max_seq_len=2048)
+        batch, prompt_len, max_new = 32, 128, 128
+    else:
+        from butterfly_tpu.core.config import tiny
+        cfg = tiny("llama", dtype="float32", param_dtype="float32")
+        batch, prompt_len, max_new = 4, 32, 32
+
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    stats = run_decode_benchmark(model, params, batch=batch,
+                                 prompt_len=prompt_len, max_new=max_new)
+    toks_per_sec_chip = stats["tokens_per_sec_per_chip"]
+
+    vs = 1.0
+    if BASELINE_FILE.exists():
+        base = json.loads(BASELINE_FILE.read_text())
+        key = "tpu" if on_tpu else "cpu"
+        if base.get(key):
+            vs = toks_per_sec_chip / base[key]
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

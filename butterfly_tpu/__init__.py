@@ -1,0 +1,30 @@
+"""Butterfly-TPU: a TPU-native distributed inference framework.
+
+A from-scratch JAX/XLA/Pallas implementation of the capability surface declared
+by the reference scaffold (TensorHusker/Butterfly, /root/reference/README.md:2,
+/root/reference/CLAUDE.md:17-23): distributed transformer inference via model
+partitioning, a low-overhead communication layer, scheduling, and a serving
+API — designed TPU-first (GSPMD shardings over a jax.sharding.Mesh, XLA
+collectives over ICI/DCN, Pallas kernels for the hot attention paths).
+
+Layer map (see SURVEY.md §1.2 / §7):
+  core/      mesh bringup, configs, dtypes
+  models/    GPT-2, Llama-3, Mixtral as pure pytree functions
+  parallel/  partitioner (sharding rules) + collective wrappers (TP/PP/EP/SP/CP)
+  ops/       Pallas kernels: flash/paged/ring attention (+ XLA fallbacks)
+  cache/     KV cache managers: contiguous + paged block tables
+  engine/    jit prefill/decode steps, samplers, training step
+  sched/     continuous-batching scheduler
+  serve/     HTTP server + `butterfly serve|generate` CLI
+  obs/       metrics, profiling hooks
+  ckpt/      HF safetensors import, sharded save/load
+"""
+
+__version__ = "0.1.0"
+
+from butterfly_tpu.core.config import (  # noqa: F401
+    ModelConfig,
+    MeshConfig,
+    RuntimeConfig,
+)
+from butterfly_tpu.core.mesh import make_mesh  # noqa: F401

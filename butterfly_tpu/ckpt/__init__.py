@@ -1,0 +1,1 @@
+from butterfly_tpu.ckpt.load import load_checkpoint, config_from_hf_dir  # noqa: F401

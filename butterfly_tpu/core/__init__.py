@@ -1,0 +1,2 @@
+from butterfly_tpu.core.config import ModelConfig, MeshConfig, RuntimeConfig  # noqa: F401
+from butterfly_tpu.core.mesh import make_mesh, local_mesh  # noqa: F401

@@ -1,0 +1,181 @@
+"""Configuration dataclasses for models, meshes, and the runtime.
+
+The reference scaffold prescribes a config/flag system only by implication
+(/root/reference/CLAUDE.md:25-27 — "To be added once build system is
+established"); we use plain frozen dataclasses: hashable (usable as jit
+static args), serializable, no global state.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a transformer LM.
+
+    One config class covers the three model families (GPT-2, Llama-3,
+    Mixtral) — the family is selected by `arch` and the MoE fields.
+    """
+
+    arch: str = "llama"  # "gpt2" | "llama" | "mixtral"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32  # < num_heads => grouped-query attention
+    head_dim: int = 128
+    intermediate_size: int = 11008
+    max_seq_len: int = 8192
+
+    # normalization / activations
+    norm_eps: float = 1e-5
+    use_bias: bool = False            # gpt2: True
+    tie_embeddings: bool = False      # gpt2: True
+    act: str = "silu"                 # gpt2: "gelu_new"; llama/mixtral: "silu"
+
+    # positional encoding
+    pos_embedding: str = "rope"       # "rope" | "learned"
+    rope_theta: float = 500000.0
+
+    # MoE (mixtral)
+    num_experts: int = 0              # 0 => dense FFN
+    num_experts_per_tok: int = 2
+
+    # numerics
+    dtype: str = "bfloat16"           # activation/weight compute dtype
+    param_dtype: str = "float32"      # master param dtype
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Presets (BASELINE.json configs[0..3] model families)
+# ---------------------------------------------------------------------------
+
+def gpt2_124m() -> ModelConfig:
+    return ModelConfig(
+        arch="gpt2", vocab_size=50257, hidden_size=768, num_layers=12,
+        num_heads=12, num_kv_heads=12, head_dim=64, intermediate_size=3072,
+        max_seq_len=1024, norm_eps=1e-5, use_bias=True, tie_embeddings=True,
+        act="gelu_new", pos_embedding="learned",
+    )
+
+
+def llama3_8b() -> ModelConfig:
+    return ModelConfig(
+        arch="llama", vocab_size=128256, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, intermediate_size=14336,
+        max_seq_len=8192, rope_theta=500000.0,
+    )
+
+
+def llama3_70b() -> ModelConfig:
+    return ModelConfig(
+        arch="llama", vocab_size=128256, hidden_size=8192, num_layers=80,
+        num_heads=64, num_kv_heads=8, head_dim=128, intermediate_size=28672,
+        max_seq_len=8192, rope_theta=500000.0,
+    )
+
+
+def mixtral_8x7b() -> ModelConfig:
+    return ModelConfig(
+        arch="mixtral", vocab_size=32000, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, intermediate_size=14336,
+        max_seq_len=32768, rope_theta=1000000.0,
+        num_experts=8, num_experts_per_tok=2,
+    )
+
+
+def tiny(arch: str = "llama", **kw) -> ModelConfig:
+    """Small config for tests: runs in <1s on CPU, exercises every code path."""
+    base = dict(
+        # 258 = ByteTokenizer vocab (bytes + BOS/EOS) so the CLI demo works.
+        vocab_size=258, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, max_seq_len=128,
+    )
+    if arch == "gpt2":
+        base.update(num_kv_heads=4, use_bias=True, tie_embeddings=True,
+                    act="gelu_new", pos_embedding="learned")
+    if arch == "mixtral":
+        base.update(num_experts=4, num_experts_per_tok=2)
+    base.update(kw)
+    return ModelConfig(arch=arch, **base)
+
+
+PRESETS = {
+    "gpt2-124m": gpt2_124m,
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+    "mixtral-8x7b": mixtral_8x7b,
+}
+
+
+# ---------------------------------------------------------------------------
+# Mesh / parallelism config
+# ---------------------------------------------------------------------------
+
+#: Canonical mesh axis names, outermost-first. Collectives over `tensor`
+#: (innermost) ride the fastest ICI links; `data` (outermost) may span DCN.
+MESH_AXES: Tuple[str, ...] = ("data", "stage", "expert", "seq", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of the parallelism axes; the product must equal device count.
+
+    data   : data parallel (replicated params, sharded batch)
+    stage  : pipeline parallel (layer groups, ppermute handoff)
+    expert : MoE expert parallel (all_to_all token routing)
+    seq    : sequence/context parallel (ring attention / Ulysses)
+    tensor : tensor parallel (Megatron row/column sharding, psum)
+    """
+
+    data: int = 1
+    stage: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    @property
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.data, self.stage, self.expert, self.seq, self.tensor)
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self.axis_sizes:
+            n *= s
+        return n
+
+    def replace(self, **kw) -> "MeshConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Serving/engine runtime knobs (BASELINE.json configs[4] surface)."""
+
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    prefill_chunk: int = 512          # chunked prefill unit
+    page_size: int = 16               # paged-KV tokens per block
+    num_pages: int = 0                # 0 => derive from max_batch/max_seq
+    scheduler: str = "continuous"     # "continuous" | "static"
+    max_queue: int = 256
+    decode_steps_per_tick: int = 1
+    port: int = 8000
+
+    def replace(self, **kw) -> "RuntimeConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,79 @@
+"""Device mesh bringup.
+
+TPU-native replacement for the reference's planned "communication layer"
+bootstrap (/root/reference/CLAUDE.md:20): instead of NCCL communicator
+setup, we build a `jax.sharding.Mesh` whose axis order maps parallelism
+kinds onto the ICI topology — `tensor` innermost (fastest links, all-reduce
+every layer), `data` outermost (least traffic, may cross DCN).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from butterfly_tpu.core.config import MESH_AXES, MeshConfig
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with the canonical axes (data, stage, expert, seq, tensor).
+
+    Axis sizes of 1 are kept (not squeezed) so PartitionSpecs can always
+    name every axis; XLA elides collectives over size-1 axes for free.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if cfg.num_devices != n:
+        raise ValueError(
+            f"MeshConfig wants {cfg.num_devices} devices "
+            f"({dict(zip(MESH_AXES, cfg.axis_sizes))}) but {n} are available"
+        )
+    dev_array = np.asarray(devices).reshape(cfg.axis_sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def local_mesh() -> Mesh:
+    """Single-device mesh (all axes size 1) — the CPU/1-chip dev loop."""
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def mesh_for(n_devices: int, tensor: int = 0, stage: int = 1, expert: int = 1,
+             seq: int = 1) -> Mesh:
+    """Convenience: fill `tensor` (or `data`) to consume n_devices."""
+    if tensor == 0:
+        tensor = n_devices // (stage * expert * seq)
+    data = n_devices // (stage * expert * seq * tensor)
+    cfg = MeshConfig(data=data, stage=stage, expert=expert, seq=seq, tensor=tensor)
+    return make_mesh(cfg, devices=jax.devices()[:n_devices])
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host control-plane bringup (SURVEY.md §3 call stack 3).
+
+    On a real pod each host calls this before `make_mesh`; jax.distributed
+    handles the DCN rendezvous that NCCL/MPI would in a GPU design. No-op
+    when single-process (the common dev/test case).
+    """
+    if num_processes is None:
+        num_processes = int(os.environ.get("BUTTERFLY_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

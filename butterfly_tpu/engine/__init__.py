@@ -1,0 +1,2 @@
+from butterfly_tpu.engine.engine import InferenceEngine, GenerateResult  # noqa: F401
+from butterfly_tpu.engine.sampling import SamplingParams, sample  # noqa: F401

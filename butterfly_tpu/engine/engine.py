@@ -1,0 +1,200 @@
+"""Inference engine: jit-compiled prefill and decode steps + generate loops.
+
+Realizes the reference's planned "Distributed Inference Engine"
+(/root/reference/CLAUDE.md:19) the TPU way:
+
+* One compiled prefill program (full-prompt forward, cache write) and one
+  compiled decode program (single-token step). Both donate the KV cache so
+  XLA updates it in place in HBM.
+* A fused generate path (`lax.scan` over decode steps inside one jit) keeps
+  the whole token loop device-resident — zero host round trips per token —
+  which is what the tokens/sec/chip metric (BASELINE.json) rewards.
+* Batch shapes are static: variable-length prompts are right-padded; padded
+  key slots sit at positions the causal mask can never reach (a query at
+  position p attends only j <= p, and pads land at j >= true_len > p), and
+  decode overwrites them before they ever become visible.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
+from butterfly_tpu.engine.sampling import SamplingParams, sample
+from butterfly_tpu.models.common import KVCache, Model, forward, init_cache
+
+
+@dataclass
+class GenerateResult:
+    tokens: np.ndarray          # [B, max_new] generated ids (post-stop garbage masked to pad)
+    lengths: np.ndarray         # [B] number of valid generated tokens
+    prompt_lengths: np.ndarray  # [B]
+
+
+class InferenceEngine:
+    """Single-program inference over a (possibly sharded) param pytree.
+
+    Sharded use: pass `shardings` pytrees for params/cache (from the
+    partitioner); jit then compiles one SPMD program over the active mesh.
+    """
+
+    def __init__(self, model: Model, params, runtime: Optional[RuntimeConfig] = None,
+                 param_shardings=None, cache_sharding=None):
+        self.model = model
+        self.cfg = model.cfg
+        self.runtime = runtime or RuntimeConfig()
+        self.params = params
+        self._prefill = jax.jit(
+            partial(_prefill_step, self.cfg),
+            donate_argnums=(2,),
+        )
+        self._decode = jax.jit(
+            partial(_decode_step, self.cfg),
+            static_argnums=(4,),
+            donate_argnums=(2,),
+        )
+        self._generate_fused = jax.jit(
+            partial(_generate_fused, self.cfg),
+            static_argnums=(4, 5),
+            donate_argnums=(2,),
+        )
+
+    # -- public API ---------------------------------------------------------
+
+    def new_cache(self, batch: int, max_seq: Optional[int] = None) -> KVCache:
+        return init_cache(self.cfg, batch, max_seq or self.runtime.max_seq_len)
+
+    def prefill(self, tokens: jax.Array, true_lens: jax.Array,
+                cache: KVCache) -> Tuple[jax.Array, KVCache]:
+        """tokens [B,Tpad] right-padded; returns (last-token logits [B,V], cache)."""
+        return self._prefill(self.params, tokens, cache, true_lens)
+
+    def decode(self, token: jax.Array, cache: KVCache, key: jax.Array,
+               sp: SamplingParams) -> Tuple[jax.Array, KVCache, jax.Array]:
+        return self._decode(self.params, token, cache, key, sp)
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sp: Optional[SamplingParams] = None,
+                 seed: int = 0, fused: bool = True) -> GenerateResult:
+        """End-to-end batched generation from python-list prompts."""
+        sp = sp or SamplingParams()
+        tokens, true_lens = pad_prompts(prompts)
+        B = tokens.shape[0]
+        total = tokens.shape[1] + sp.max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({tokens.shape[1]}) + max_new_tokens "
+                f"({sp.max_new_tokens}) = {total} exceeds the model's "
+                f"max_seq_len ({self.cfg.max_seq_len})")
+        max_seq = max(self.runtime.max_seq_len, total)
+        cache = self.new_cache(B, max_seq)
+        key, first_key, loop_key = jax.random.split(jax.random.PRNGKey(seed), 3)
+
+        logits, cache = self.prefill(jnp.asarray(tokens), jnp.asarray(true_lens),
+                                     cache)
+        first = sample(logits, first_key, sp)
+
+        if fused:
+            out, lens = self._generate_fused(self.params, first, cache, loop_key,
+                                             sp, sp.max_new_tokens)
+            out, lens = np.asarray(out), np.asarray(lens)
+        else:
+            toks = [np.asarray(first)]
+            cur = first
+            key = loop_key
+            for _ in range(sp.max_new_tokens - 1):
+                key, sub = jax.random.split(key)
+                cur, cache, _ = self.decode(cur, cache, sub, sp)
+                toks.append(np.asarray(cur))
+            out = np.stack(toks, axis=1)
+            lens = _stop_lengths(out, sp.stop_token)
+            out = _mask_after_stop(out, lens, sp.stop_token)
+        return GenerateResult(tokens=out, lengths=lens,
+                              prompt_lengths=np.asarray(true_lens))
+
+
+# ---------------------------------------------------------------------------
+# jitted step functions (module-level so jit caches persist across engines)
+# ---------------------------------------------------------------------------
+
+def _prefill_step(cfg: ModelConfig, params, tokens, cache, true_lens):
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    logits, cache = forward(params, cfg, tokens, cache, positions)
+    # gather last *real* token's logits; fix per-seq lengths
+    last = jnp.take_along_axis(logits, (true_lens - 1)[:, None, None], axis=1)
+    cache = KVCache(cache.k, cache.v, true_lens.astype(jnp.int32))
+    return last[:, 0, :], cache
+
+
+def _decode_step(cfg: ModelConfig, params, token, cache, key, sp: SamplingParams):
+    logits, cache = forward(params, cfg, token[:, None], cache)
+    key, sub = jax.random.split(key)
+    nxt = sample(logits[:, -1, :], sub, sp)
+    return nxt, cache, key
+
+
+def _generate_fused(cfg: ModelConfig, params, first, cache, key,
+                    sp: SamplingParams, max_new: int):
+    """lax.scan over decode steps — the whole generation is one XLA program.
+
+    Sequences that hit the stop token keep stepping (static shapes) but
+    their outputs are frozen via the done mask; no recompilation, no host
+    sync until the final device->host copy.
+    """
+    def body(carry, _):
+        cur, cache, key, done = carry
+        logits, cache = forward(params, cfg, cur[:, None], cache)
+        key, sub = jax.random.split(key)
+        nxt = sample(logits[:, -1, :], sub, sp)
+        nxt = jnp.where(done, cur, nxt)
+        if sp.stop_token >= 0:
+            done = done | (nxt == sp.stop_token)
+        return (nxt, cache, key, done), nxt
+
+    done0 = (first == sp.stop_token) if sp.stop_token >= 0 \
+        else jnp.zeros_like(first, dtype=bool)
+    _, toks = jax.lax.scan(
+        body, (first, cache, key, done0), None, length=max_new - 1)
+    out = jnp.concatenate([first[:, None], toks.T], axis=1)  # [B, max_new]
+    lens = _stop_lengths_jnp(out, sp.stop_token)
+    return out, lens
+
+
+def _stop_lengths_jnp(out: jax.Array, stop: int) -> jax.Array:
+    B, T = out.shape
+    if stop < 0:
+        return jnp.full((B,), T, jnp.int32)
+    hit = out == stop
+    any_hit = hit.any(axis=1)
+    first_hit = jnp.argmax(hit, axis=1)
+    return jnp.where(any_hit, first_hit + 1, T).astype(jnp.int32)
+
+
+def _stop_lengths(out: np.ndarray, stop: int) -> np.ndarray:
+    return np.asarray(_stop_lengths_jnp(jnp.asarray(out), stop))
+
+
+def _mask_after_stop(out: np.ndarray, lens: np.ndarray, stop: int) -> np.ndarray:
+    if stop < 0:
+        return out
+    mask = np.arange(out.shape[1])[None, :] >= lens[:, None]
+    out = out.copy()
+    out[mask] = stop
+    return out
+
+
+def pad_prompts(prompts: Sequence[Sequence[int]], pad_id: int = 0
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Right-pad variable-length prompts to a rectangle."""
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    T = int(lens.max())
+    out = np.full((len(prompts), T), pad_id, np.int32)
+    for i, p in enumerate(prompts):
+        out[i, :len(p)] = np.asarray(p, np.int32)
+    return out, lens

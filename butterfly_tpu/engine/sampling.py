@@ -1,0 +1,55 @@
+"""Token samplers: greedy, temperature, top-k, top-p.
+
+Pure functions of (logits, key, params) so they live inside the jitted
+decode step — no host round trip per token. All filtering is done with
+static-shape sorts/masks (no dynamic shapes under jit, per XLA semantics).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0   # 0 => greedy
+    top_k: int = 0             # 0 => disabled
+    top_p: float = 1.0         # 1.0 => disabled
+    max_new_tokens: int = 128
+    stop_token: int = -1       # -1 => none
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens until cumulative prob exceeds p (always keep the first)
+    cutoff_mask = cum - probs > p
+    cutoff = jnp.where(cutoff_mask, -jnp.inf, sorted_logits)
+    threshold = jnp.min(jnp.where(jnp.isfinite(cutoff), cutoff, jnp.inf),
+                        axis=-1, keepdims=True)
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def sample(logits: jax.Array, key: jax.Array, sp: SamplingParams) -> jax.Array:
+    """logits [B,V] float32 -> token ids [B] int32. Branches are static
+    (SamplingParams is a jit-static argument)."""
+    if sp.is_greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / sp.temperature
+    if sp.top_k > 0:
+        logits = _apply_top_k(logits, sp.top_k)
+    if sp.top_p < 1.0:
+        logits = _apply_top_p(logits, sp.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

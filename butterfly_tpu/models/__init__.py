@@ -1,0 +1,2 @@
+from butterfly_tpu.models import common, gpt2, llama  # noqa: F401
+from butterfly_tpu.models.common import init_params, forward, Model  # noqa: F401

@@ -1,0 +1,394 @@
+"""Functional transformer core shared by GPT-2 / Llama-3 / Mixtral.
+
+Design (TPU-first, not a torch translation):
+
+* Params are plain pytrees (nested dicts of jnp arrays). No Module system —
+  pure functions keep every transform (jit, grad, shard_map, scan) trivially
+  applicable, and sharding is attached by the partitioner
+  (butterfly_tpu.parallel.partition) as PartitionSpecs over leaf paths.
+* Per-layer weights are STACKED on a leading layer axis and the forward pass
+  is `lax.scan` over layers: one traced layer body regardless of depth, so a
+  70B/80-layer model compiles as fast as a 2-layer one, and pipeline
+  parallelism can slice the same stacked leaves into stages.
+* The KV cache is a pytree of [L, B, S, Kv, H] arrays updated in-place via
+  vmapped `lax.dynamic_update_slice` (XLA DynamicUpdateSlice keeps it
+  HBM-resident, per the north star in BASELINE.json).
+
+Capability parity note: this realizes the reference's planned "Distributed
+Inference Engine" model side (/root/reference/CLAUDE.md:19,21) for which no
+implementation exists (see SURVEY.md §0).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from butterfly_tpu.core.config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Contiguous KV cache: [num_layers, batch, max_seq, num_kv_heads, head_dim].
+
+    `length[b]` = number of tokens already written for sequence b.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # [B] int32
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq: int,
+               dtype: Optional[jnp.dtype] = None) -> KVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        length=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * scale.astype(jnp.float32)).astype(dt)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dt)
+
+
+def gelu_new(x: jax.Array) -> jax.Array:
+    """GPT-2's tanh-approximated GELU."""
+    c = jnp.sqrt(2.0 / jnp.pi).astype(x.dtype)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+
+
+ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "gelu_new": gelu_new,
+    "relu": jax.nn.relu,
+}
+
+
+def rope_freqs(cfg: ModelConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for positions [..., T] -> [..., T, head_dim/2], f32."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate-half convention (matches HF Llama so imported weights agree).
+
+    x: [B, T, N, H]; cos/sin: [B, T, half] (or [T, half]).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[..., None, :].astype(x.dtype)  # broadcast over heads
+    sin = sin[..., None, :].astype(x.dtype)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    return jnp.concatenate([r1, r2], axis=-1)
+
+
+def update_cache_layer(ck: jax.Array, cv: jax.Array, k: jax.Array, v: jax.Array,
+                       start: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Write k/v [B,T,Kv,H] into cache [B,S,Kv,H] at per-sequence offsets.
+
+    vmapped DynamicUpdateSlice over the batch — stays HBM-resident, no
+    host round trip (north-star requirement, BASELINE.json).
+    """
+    def upd(cache_b, new_b, start_b):
+        return lax.dynamic_update_slice(cache_b, new_b, (start_b, 0, 0))
+
+    ck = jax.vmap(upd)(ck, k.astype(ck.dtype), start)
+    cv = jax.vmap(upd)(cv, v.astype(cv.dtype), start)
+    return ck, cv
+
+
+def attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array,
+           cfg: ModelConfig) -> jax.Array:
+    """Grouped-query attention over the (cached) key/value sequence.
+
+    q: [B, T, Nq, H]; k/v: [B, S, Kv, H]; mask: [B, T, S] bool (True=attend).
+    Returns [B, T, Nq, H]. Softmax in f32 for stability.
+    """
+    B, T, Nq, H = q.shape
+    S = k.shape[1]
+    Kv = k.shape[2]
+    G = Nq // Kv
+    q = q.reshape(B, T, Kv, G, H)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(H, jnp.float32))
+    scores = jnp.einsum("btkgh,bskh->bktgs", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * scale
+    scores = jnp.where(mask[:, None, :, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bktgs,bskh->btkgh", probs.astype(v.dtype), v)
+    return out.reshape(B, T, Nq, H)
+
+
+# ---------------------------------------------------------------------------
+# Layer bodies
+# ---------------------------------------------------------------------------
+
+def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
+                    ck: jax.Array, cv: jax.Array,
+                    positions: jax.Array, mask: jax.Array,
+                    cos: jax.Array, sin: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One attention sublayer with cache update.
+
+    x: [B,T,D]; ck/cv: [B,S,Kv,H]; positions: [B,T]; mask: [B,T,S].
+    """
+    B, T, D = x.shape
+    q = jnp.einsum("btd,dnh->btnh", x, p["wq"])
+    k = jnp.einsum("btd,dkh->btkh", x, p["wk"])
+    v = jnp.einsum("btd,dkh->btkh", x, p["wv"])
+    if cfg.use_bias:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    if cfg.pos_embedding == "rope":
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+    start = positions[:, 0]  # write offset per sequence
+    ck, cv = update_cache_layer(ck, cv, k, v, start)
+    out = attend(q, ck, cv, mask, cfg)
+    out = jnp.einsum("btnh,nhd->btd", out, p["wo"])
+    if cfg.use_bias:
+        out = out + p["bo"]
+    return out, ck, cv
+
+
+def mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    act = ACTIVATIONS[cfg.act]
+    if cfg.arch == "gpt2":
+        h = jnp.einsum("btd,df->btf", x, p["w_up"]) + p["b_up"]
+        h = act(h)
+        out = jnp.einsum("btf,fd->btd", h, p["w_down"]) + p["b_down"]
+        return out
+    # llama-style gated SwiGLU
+    g = jnp.einsum("btd,df->btf", x, p["w_gate"])
+    u = jnp.einsum("btd,df->btf", x, p["w_up"])
+    h = act(g) * u
+    return jnp.einsum("btf,fd->btd", h, p["w_down"])
+
+
+def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Dense-compute MoE (every expert sees every token, masked by router).
+
+    The expert-parallel all_to_all path lives in parallel/expert.py; this
+    dense form is the single-device reference and the EP fallback.
+    """
+    B, T, D = x.shape
+    logits = jnp.einsum("btd,de->bte", x, p["router"]).astype(jnp.float32)
+    weights, idx = lax.top_k(logits, cfg.num_experts_per_tok)
+    weights = jax.nn.softmax(weights, axis=-1)  # [B,T,k]
+    onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.float32)  # [B,T,k,E]
+    comb = jnp.einsum("btk,btke->bte", weights, onehot)  # [B,T,E]
+
+    act = ACTIVATIONS[cfg.act]
+    g = jnp.einsum("btd,edf->ebtf", x, p["w_gate"])
+    u = jnp.einsum("btd,edf->ebtf", x, p["w_up"])
+    h = act(g) * u
+    y = jnp.einsum("ebtf,efd->ebtd", h, p["w_down"])
+    return jnp.einsum("ebtd,bte->btd", y, comb.astype(y.dtype))
+
+
+def transformer_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
+                      ck: jax.Array, cv: jax.Array,
+                      positions: jax.Array, mask: jax.Array,
+                      cos: jax.Array, sin: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pre-norm residual block: x + attn(norm(x)); x + ffn(norm(x))."""
+    if cfg.arch == "gpt2":
+        h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], cfg.norm_eps)
+    else:
+        h = rms_norm(x, lp["ln1"]["scale"], cfg.norm_eps)
+    attn_out, ck, cv = attention_block(h, lp["attn"], cfg, ck, cv,
+                                       positions, mask, cos, sin)
+    x = x + attn_out
+
+    if cfg.arch == "gpt2":
+        h = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], cfg.norm_eps)
+    else:
+        h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
+    if cfg.is_moe:
+        ffn_out = moe_block(h, lp["moe"], cfg)
+    else:
+        ffn_out = mlp_block(h, lp["mlp"], cfg)
+    x = x + ffn_out
+    return x, ck, cv
+
+
+# ---------------------------------------------------------------------------
+# Full forward
+# ---------------------------------------------------------------------------
+
+def make_mask(positions: jax.Array, S: int) -> jax.Array:
+    """Causal mask over the cache: [B,T,S], True where query may attend.
+
+    A query at absolute position p attends to cache slots j <= p. Slots
+    beyond the written region have j > p and are excluded automatically
+    (new tokens are written into the cache before attending).
+    """
+    j = jnp.arange(S)[None, None, :]
+    return j <= positions[:, :, None]
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            cache: KVCache, positions: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, KVCache]:
+    """Run the model over `tokens` [B,T], reading/updating `cache`.
+
+    positions defaults to cache.length[:,None] + arange(T) (append).
+    Returns (logits [B,T,V] float32, updated cache).
+    """
+    B, T = tokens.shape
+    if positions is None:
+        positions = cache.length[:, None] + jnp.arange(T)[None, :]
+
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"]["tok"].astype(compute_dtype)[tokens]
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["pos"].astype(compute_dtype)[positions]
+        cos = sin = jnp.zeros((B, T, cfg.head_dim // 2), jnp.float32)
+    else:
+        cos, sin = rope_freqs(cfg, positions)
+
+    mask = make_mask(positions, cache.max_seq)
+
+    def body(x, scanned):
+        lp, ck, cv = scanned
+        lp = jax.tree.map(lambda a: a.astype(compute_dtype), lp)
+        x, ck, cv = transformer_layer(x, lp, cfg, ck, cv,
+                                      positions, mask, cos, sin)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+
+    if cfg.arch == "gpt2":
+        x = layer_norm(x, params["final_norm"]["scale"],
+                       params["final_norm"]["bias"], cfg.norm_eps)
+    else:
+        x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("btd,vd->btv", x,
+                            params["embed"]["tok"].astype(compute_dtype))
+    else:
+        logits = jnp.einsum("btd,dv->btv", x,
+                            params["lm_head"].astype(compute_dtype))
+
+    new_len = cache.length + T
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, new_len)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init (normal, 0.02 std — GPT-2 style) in cfg.param_dtype."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    L, D, Nq, Kv, H, F, V = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                             cfg.num_kv_heads, cfg.head_dim,
+                             cfg.intermediate_size, cfg.vocab_size)
+    keys = iter(jax.random.split(key, 32))
+
+    def w(k, *shape, std=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(pdt)
+
+    layers: Params = {
+        "ln1": {"scale": jnp.ones((L, D), pdt)},
+        "ln2": {"scale": jnp.ones((L, D), pdt)},
+        "attn": {
+            "wq": w(next(keys), L, D, Nq, H),
+            "wk": w(next(keys), L, D, Kv, H),
+            "wv": w(next(keys), L, D, Kv, H),
+            "wo": w(next(keys), L, Nq, H, D),
+        },
+    }
+    if cfg.use_bias:
+        layers["ln1"]["bias"] = jnp.zeros((L, D), pdt)
+        layers["ln2"]["bias"] = jnp.zeros((L, D), pdt)
+        layers["attn"].update(
+            bq=jnp.zeros((L, Nq, H), pdt), bk=jnp.zeros((L, Kv, H), pdt),
+            bv=jnp.zeros((L, Kv, H), pdt), bo=jnp.zeros((L, D), pdt),
+        )
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["moe"] = {
+            "router": w(next(keys), L, D, E),
+            "w_gate": w(next(keys), L, E, D, F),
+            "w_up": w(next(keys), L, E, D, F),
+            "w_down": w(next(keys), L, E, F, D),
+        }
+    elif cfg.arch == "gpt2":
+        layers["mlp"] = {
+            "w_up": w(next(keys), L, D, F), "b_up": jnp.zeros((L, F), pdt),
+            "w_down": w(next(keys), L, F, D), "b_down": jnp.zeros((L, D), pdt),
+        }
+    else:
+        layers["mlp"] = {
+            "w_gate": w(next(keys), L, D, F),
+            "w_up": w(next(keys), L, D, F),
+            "w_down": w(next(keys), L, F, D),
+        }
+
+    params: Params = {
+        "embed": {"tok": w(next(keys), V, D)},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((D,), pdt)},
+    }
+    if cfg.pos_embedding == "learned":
+        params["embed"]["pos"] = w(next(keys), cfg.max_seq_len, D)
+    if cfg.arch == "gpt2":
+        params["final_norm"]["bias"] = jnp.zeros((D,), pdt)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(next(keys), D, V)
+    return params
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """Thin handle bundling a config with the functional API."""
+
+    cfg: ModelConfig
+
+    def init(self, key: jax.Array) -> Params:
+        return init_params(self.cfg, key)
+
+    def init_cache(self, batch: int, max_seq: int, dtype=None) -> KVCache:
+        return init_cache(self.cfg, batch, max_seq, dtype)
+
+    def __call__(self, params: Params, tokens: jax.Array, cache: KVCache,
+                 positions: Optional[jax.Array] = None):
+        return forward(params, self.cfg, tokens, cache, positions)

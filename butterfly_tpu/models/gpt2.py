@@ -1,0 +1,78 @@
+"""GPT-2 model family (BASELINE.json configs[0]: 124M CPU greedy reference).
+
+The architecture-specific parts (LayerNorm+bias, learned positions, gelu_new,
+fused-then-split qkv in HF checkpoints, tied lm_head) are expressed through
+ModelConfig flags; the forward pass is models/common.py. This module adds the
+HF-weight mapping used by the golden parity tests and the checkpoint importer.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.core.config import ModelConfig, gpt2_124m  # noqa: F401
+from butterfly_tpu.models.common import Model
+
+
+def model(cfg: ModelConfig | None = None) -> Model:
+    return Model(cfg or gpt2_124m())
+
+
+def params_from_hf_state_dict(sd: Dict[str, Any], cfg: ModelConfig) -> Dict:
+    """Convert a HF transformers GPT2LMHeadModel state_dict to our pytree.
+
+    HF GPT-2 uses Conv1D (weight stored [in, out], same orientation as our
+    `x @ w` layout) and a fused c_attn producing q|k|v along the out axis.
+    Tensors arrive as torch; we convert via numpy. Layer tensors are stacked
+    on a leading L axis to match the scan layout.
+    """
+    def g(name):
+        t = sd[name]
+        return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                          dtype=np.float32)
+
+    L, D, N, H = cfg.num_layers, cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def stack(fmt, post=lambda a: a):
+        return jnp.asarray(np.stack([post(g(fmt.format(i))) for i in range(L)]))
+
+    # fused qkv: [D, 3D] -> three [D, N, H]
+    qkv_w = [g(f"transformer.h.{i}.attn.c_attn.weight") for i in range(L)]
+    qkv_b = [g(f"transformer.h.{i}.attn.c_attn.bias") for i in range(L)]
+    wq = jnp.asarray(np.stack([w[:, :D].reshape(D, N, H) for w in qkv_w]))
+    wk = jnp.asarray(np.stack([w[:, D:2 * D].reshape(D, N, H) for w in qkv_w]))
+    wv = jnp.asarray(np.stack([w[:, 2 * D:].reshape(D, N, H) for w in qkv_w]))
+    bq = jnp.asarray(np.stack([b[:D].reshape(N, H) for b in qkv_b]))
+    bk = jnp.asarray(np.stack([b[D:2 * D].reshape(N, H) for b in qkv_b]))
+    bv = jnp.asarray(np.stack([b[2 * D:].reshape(N, H) for b in qkv_b]))
+
+    params = {
+        "embed": {
+            "tok": jnp.asarray(g("transformer.wte.weight")),
+            "pos": jnp.asarray(g("transformer.wpe.weight")),
+        },
+        "layers": {
+            "ln1": {"scale": stack("transformer.h.{}.ln_1.weight"),
+                    "bias": stack("transformer.h.{}.ln_1.bias")},
+            "ln2": {"scale": stack("transformer.h.{}.ln_2.weight"),
+                    "bias": stack("transformer.h.{}.ln_2.bias")},
+            "attn": {
+                "wq": wq, "wk": wk, "wv": wv,
+                "bq": bq, "bk": bk, "bv": bv,
+                "wo": stack("transformer.h.{}.attn.c_proj.weight",
+                            post=lambda a: a.reshape(N, H, D)),
+                "bo": stack("transformer.h.{}.attn.c_proj.bias"),
+            },
+            "mlp": {
+                "w_up": stack("transformer.h.{}.mlp.c_fc.weight"),
+                "b_up": stack("transformer.h.{}.mlp.c_fc.bias"),
+                "w_down": stack("transformer.h.{}.mlp.c_proj.weight"),
+                "b_down": stack("transformer.h.{}.mlp.c_proj.bias"),
+            },
+        },
+        "final_norm": {"scale": jnp.asarray(g("transformer.ln_f.weight")),
+                       "bias": jnp.asarray(g("transformer.ln_f.bias"))},
+    }
+    return params

@@ -1,0 +1,70 @@
+"""Llama-3 model family (BASELINE.json configs[1] 8B TP=8, configs[2] 70B TPxPP).
+
+RMSNorm, RoPE (rotate-half, matching the HF convention so imported
+safetensors agree numerically), GQA, SwiGLU, untied lm_head — all expressed
+via ModelConfig over the shared functional core in models/common.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.core.config import ModelConfig, llama3_8b, llama3_70b  # noqa: F401
+from butterfly_tpu.models.common import Model
+
+
+def model(cfg: ModelConfig | None = None) -> Model:
+    return Model(cfg or llama3_8b())
+
+
+def params_from_hf_state_dict(sd: Dict[str, Any], cfg: ModelConfig) -> Dict:
+    """Convert a HF transformers LlamaForCausalLM state_dict to our pytree.
+
+    HF Linear stores weight as [out, in]; our layout is [in, ...out]. The
+    q/k/v projections additionally reshape the out axis into (heads, head_dim).
+    """
+    def g(name):
+        t = sd[name]
+        return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                          dtype=np.float32)
+
+    L, D = cfg.num_layers, cfg.hidden_size
+    Nq, Kv, H = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def stack(fmt, post=lambda a: a):
+        return jnp.asarray(np.stack([post(g(fmt.format(i))) for i in range(L)]))
+
+    def proj(n_heads):
+        # [out, in] -> [in, heads, head_dim]
+        return lambda a: a.T.reshape(D, n_heads, H)
+
+    params = {
+        "embed": {"tok": jnp.asarray(g("model.embed_tokens.weight"))},
+        "layers": {
+            "ln1": {"scale": stack("model.layers.{}.input_layernorm.weight")},
+            "ln2": {"scale": stack("model.layers.{}.post_attention_layernorm.weight")},
+            "attn": {
+                "wq": stack("model.layers.{}.self_attn.q_proj.weight", proj(Nq)),
+                "wk": stack("model.layers.{}.self_attn.k_proj.weight", proj(Kv)),
+                "wv": stack("model.layers.{}.self_attn.v_proj.weight", proj(Kv)),
+                "wo": stack("model.layers.{}.self_attn.o_proj.weight",
+                            post=lambda a: a.T.reshape(Nq, H, D)),
+            },
+            "mlp": {
+                "w_gate": stack("model.layers.{}.mlp.gate_proj.weight",
+                                post=lambda a: a.T),
+                "w_up": stack("model.layers.{}.mlp.up_proj.weight",
+                              post=lambda a: a.T),
+                "w_down": stack("model.layers.{}.mlp.down_proj.weight",
+                                post=lambda a: a.T),
+            },
+        },
+        "final_norm": {"scale": jnp.asarray(g("model.norm.weight"))},
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = jnp.asarray(g("lm_head.weight").T)
+    else:  # tied
+        params["lm_head"] = jnp.asarray(g("model.embed_tokens.weight").T)
+    return params

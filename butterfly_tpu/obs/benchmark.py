@@ -1,0 +1,42 @@
+"""Shared decode-throughput benchmark (used by bench.py and `butterfly bench`).
+
+Reports both raw tokens/sec and tokens/sec/chip (the BASELINE.json metric
+of record); one implementation so the two entrypoints can't drift.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def run_decode_benchmark(model, params, batch: int, prompt_len: int,
+                         max_new: int, seed: int = 0) -> Dict:
+    import jax
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine import InferenceEngine, SamplingParams
+
+    engine = InferenceEngine(
+        model, params, RuntimeConfig(max_seq_len=prompt_len + max_new))
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(1, model.cfg.vocab_size,
+                          (batch, prompt_len)).tolist()
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    engine.generate(prompts, sp)  # compile + warmup
+    t0 = time.perf_counter()
+    engine.generate(prompts, sp)
+    dt = time.perf_counter() - t0
+
+    n_chips = max(1, len(jax.devices()))
+    total = batch * max_new
+    return {
+        "tokens_per_sec": total / dt,
+        "tokens_per_sec_per_chip": total / dt / n_chips,
+        "decode_seconds": dt,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": max_new,
+        "n_chips": n_chips,
+    }

@@ -1,0 +1,139 @@
+"""`butterfly` CLI: the reference's planned client-facing entrypoints
+(/root/reference/CLAUDE.md:23; BASELINE.json north_star names
+`butterfly serve` / `generate`).
+
+    butterfly generate --model gpt2-124m --prompt "hello" --max-new 32
+    butterfly serve    --model llama3-8b --port 8000
+    butterfly bench    --model tiny
+
+Models load from --ckpt (HF safetensors dir or our sharded checkpoint);
+without --ckpt, weights are random-initialized (smoke/demo mode).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="butterfly",
+                                description="Butterfly-TPU inference CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--model", default="tiny",
+                        help="preset name (gpt2-124m, llama3-8b, llama3-70b, "
+                             "mixtral-8x7b) or 'tiny'")
+        sp.add_argument("--ckpt", default=None, help="checkpoint path")
+        sp.add_argument("--tokenizer", default=None)
+        sp.add_argument("--dtype", default=None, help="override compute dtype")
+        sp.add_argument("--tensor-parallel", type=int, default=1)
+        sp.add_argument("--stage-parallel", type=int, default=1)
+        sp.add_argument("--expert-parallel", type=int, default=1)
+        sp.add_argument("--max-seq", type=int, default=2048)
+
+    g = sub.add_parser("generate", help="one-shot text generation")
+    common(g)
+    g.add_argument("--prompt", default="Hello")
+    g.add_argument("--max-new", type=int, default=64)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=0)
+    g.add_argument("--top-p", type=float, default=1.0)
+    g.add_argument("--seed", type=int, default=0)
+
+    s = sub.add_parser("serve", help="HTTP serving with continuous batching")
+    common(s)
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--max-batch", type=int, default=8)
+    s.add_argument("--page-size", type=int, default=16)
+
+    b = sub.add_parser("bench", help="throughput microbenchmark")
+    common(b)
+    b.add_argument("--batch", type=int, default=8)
+    b.add_argument("--prompt-len", type=int, default=128)
+    b.add_argument("--max-new", type=int, default=128)
+    return p
+
+
+def resolve_model(args):
+    from butterfly_tpu.core.config import PRESETS, tiny
+    from butterfly_tpu.models.common import Model
+    if args.model == "tiny":
+        cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    else:
+        cfg = PRESETS[args.model]()
+    if args.dtype:
+        cfg = cfg.replace(dtype=args.dtype)
+    return Model(cfg)
+
+
+def load_params(model, args):
+    import jax
+    if args.ckpt:
+        from butterfly_tpu.ckpt import load_checkpoint
+        return load_checkpoint(args.ckpt, model.cfg)
+    return model.init(jax.random.PRNGKey(0))
+
+
+def cmd_generate(args) -> int:
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine import InferenceEngine, SamplingParams
+    from butterfly_tpu.utils.tokenizer import load_tokenizer
+
+    model = resolve_model(args)
+    tok = load_tokenizer(args.tokenizer or args.ckpt)
+    params = load_params(model, args)
+    engine = InferenceEngine(model, params,
+                             runtime=RuntimeConfig(max_seq_len=args.max_seq))
+    vocab = model.cfg.vocab_size
+    stop = tok.eos_id if tok.eos_id is not None and tok.eos_id < vocab else -1
+    sp = SamplingParams(temperature=args.temperature, top_k=args.top_k,
+                        top_p=args.top_p, max_new_tokens=args.max_new,
+                        stop_token=stop)
+    ids = tok.encode(args.prompt)
+    bad = [i for i in ids if i >= vocab]
+    if bad:
+        print(f"error: tokenizer produced ids {bad[:5]} outside the model's "
+              f"vocab ({vocab}); pass a matching --tokenizer", file=sys.stderr)
+        return 2
+    t0 = time.perf_counter()
+    res = engine.generate([ids], sp, seed=args.seed)
+    dt = time.perf_counter() - t0
+    n = int(res.lengths[0])
+    text = tok.decode(res.tokens[0, :n].tolist())
+    print(text)
+    print(f"[butterfly] {n} tokens in {dt:.2f}s "
+          f"({n / dt:.1f} tok/s incl. compile)", file=sys.stderr)
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from butterfly_tpu.serve.server import run_server
+    return run_server(args)
+
+
+def cmd_bench(args) -> int:
+    from butterfly_tpu.obs.benchmark import run_decode_benchmark
+
+    model = resolve_model(args)
+    params = load_params(model, args)
+    stats = run_decode_benchmark(model, params, batch=args.batch,
+                                 prompt_len=args.prompt_len,
+                                 max_new=args.max_new)
+    print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
+                      "value": stats["tokens_per_sec_per_chip"],
+                      "unit": "tokens/sec/chip", **stats}))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return {"generate": cmd_generate, "serve": cmd_serve,
+            "bench": cmd_bench}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

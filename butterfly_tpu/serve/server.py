@@ -1,0 +1,10 @@
+"""HTTP serving endpoint — implemented with the continuous-batching
+scheduler in slice 4 (SURVEY.md §7 build order step 4)."""
+from __future__ import annotations
+
+
+def run_server(args) -> int:
+    raise NotImplementedError(
+        "`butterfly serve` requires the continuous-batching scheduler "
+        "(butterfly_tpu.sched), which lands in the next build slice. "
+        "Use `butterfly generate` for one-shot inference meanwhile.")

@@ -1,0 +1,33 @@
+"""Test harness: force an 8-fake-device CPU backend (SURVEY.md §4).
+
+Every mesh/collective/partitioner/pipeline test runs on one host by
+pretending to have 8 CPU devices. The axon sitecustomize registers the real
+TPU backend at interpreter start and pins JAX_PLATFORMS=axon, so a plain
+env setdefault is not enough: we must override via jax.config before any
+backend is initialized.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
+    assert len(jax.devices()) == 8, "tests expect 8 fake CPU devices"
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from butterfly_tpu.core.config import MeshConfig
+    from butterfly_tpu.core.mesh import make_mesh
+    return make_mesh(MeshConfig(tensor=8))
