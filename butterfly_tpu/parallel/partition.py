@@ -1,0 +1,180 @@
+"""Transformer partitioner: param/cache/activation PartitionSpecs.
+
+Realizes the reference's planned "Model Partitioning" layer
+(/root/reference/CLAUDE.md:21 — "Algorithms to intelligently divide
+transformer layers/attention heads") the TPU way: instead of manually
+slicing tensors and issuing NCCL calls, we attach `PartitionSpec`s to every
+leaf of the param/cache pytrees and let GSPMD lower the einsums to sharded
+matmuls with `all-reduce`/`all-gather` placed at the Megatron-canonical
+points:
+
+* attention: wq/wk/wv column-parallel (heads sharded over `tensor`), wo
+  row-parallel -> one all-reduce per attention block;
+* MLP: w_up/w_gate column-parallel, w_down row-parallel -> one all-reduce
+  per MLP block;
+* MoE experts sharded over `expert` (dispatch handled in parallel/expert.py);
+* embedding vocab-sharded; lm_head column-parallel over vocab;
+* KV cache: batch over `data`, kv-heads over `tensor`.
+
+Sharding is *advisory for layout, mandatory for memory*: a spec only ever
+shards a dim that divides evenly by the mesh axis (else that dim is
+replicated), so any (cfg, mesh) combination is valid. Tests verify parity
+TP=1 vs TP=8 and assert the expected collectives appear in the compiled
+HLO (SURVEY.md §7 stage 2).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from butterfly_tpu.core.config import ModelConfig
+from butterfly_tpu.models.common import KVCache
+
+Specs = Dict[str, Any]
+
+
+def _div(n: int, mesh: Mesh, axis: str) -> Optional[str]:
+    """Return `axis` if dim of size n shards evenly over it, else None."""
+    return axis if n % mesh.shape[axis] == 0 and mesh.shape[axis] > 1 else None
+
+
+def param_specs(cfg: ModelConfig, mesh: Mesh) -> Specs:
+    """PartitionSpec pytree mirroring models.common.init_params exactly.
+
+    Layer-stacked leaves have a leading L dim; when pipeline parallelism is
+    active (mesh axis `stage` > 1) that dim is sharded over `stage` so each
+    stage group holds only its own layers' weights.
+    """
+    D, Nq, Kv, F, V = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                       cfg.intermediate_size, cfg.vocab_size)
+    tp = lambda n: _div(n, mesh, "tensor")  # noqa: E731
+    L = _div(cfg.num_layers, mesh, "stage")
+
+    layers: Specs = {
+        "ln1": {"scale": P(L, None)},
+        "ln2": {"scale": P(L, None)},
+        "attn": {
+            "wq": P(L, None, tp(Nq), None),   # column-parallel (heads)
+            "wk": P(L, None, tp(Kv), None),
+            "wv": P(L, None, tp(Kv), None),
+            "wo": P(L, tp(Nq), None, None),   # row-parallel -> all-reduce
+        },
+    }
+    if cfg.use_bias:
+        layers["ln1"]["bias"] = P(L, None)
+        layers["ln2"]["bias"] = P(L, None)
+        layers["attn"].update(
+            bq=P(L, tp(Nq), None), bk=P(L, tp(Kv), None),
+            bv=P(L, tp(Kv), None), bo=P(L, None),
+        )
+    if cfg.is_moe:
+        E = cfg.num_experts
+        ep = _div(E, mesh, "expert")
+        layers["moe"] = {
+            "router": P(L, None, None),
+            "w_gate": P(L, ep, None, tp(F)),
+            "w_up": P(L, ep, None, tp(F)),
+            "w_down": P(L, ep, tp(F), None),
+        }
+    elif cfg.arch == "gpt2":
+        layers["mlp"] = {
+            "w_up": P(L, None, tp(F)), "b_up": P(L, tp(F)),
+            "w_down": P(L, tp(F), None), "b_down": P(L, None),
+        }
+    else:
+        layers["mlp"] = {
+            "w_gate": P(L, None, tp(F)),
+            "w_up": P(L, None, tp(F)),
+            "w_down": P(L, tp(F), None),
+        }
+
+    specs: Specs = {
+        "embed": {"tok": P(tp(V), None)},
+        "layers": layers,
+        "final_norm": {"scale": P(None)},
+    }
+    if cfg.pos_embedding == "learned":
+        specs["embed"]["pos"] = P(None, None)
+    if cfg.arch == "gpt2":
+        specs["final_norm"]["bias"] = P(None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, tp(V))
+    return specs
+
+
+def cache_specs(cfg: ModelConfig, mesh: Mesh) -> KVCache:
+    """Specs for the KVCache pytree [L,B,S,Kv,H]: batch x data, heads x tensor."""
+    kv = P(None, _div_any(mesh, "data"), None,
+           _div(cfg.num_kv_heads, mesh, "tensor"), None)
+    return KVCache(k=kv, v=kv, length=P(_div_any(mesh, "data")))
+
+
+def _div_any(mesh: Mesh, axis: str) -> Optional[str]:
+    """Axis name if it is active (>1); batch dims are chosen divisible."""
+    return axis if mesh.shape[axis] > 1 else None
+
+
+def activation_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
+    """[B,T,D] activations: batch over data, optionally seq over `seq`."""
+    return P(_div_any(mesh, "data"), "seq" if seq_sharded and
+             mesh.shape["seq"] > 1 else None, None)
+
+
+def logits_spec(cfg: ModelConfig, mesh: Mesh) -> P:
+    return P(_div_any(mesh, "data"), None, _div(cfg.vocab_size, mesh, "tensor"))
+
+
+# ---------------------------------------------------------------------------
+# Application helpers
+# ---------------------------------------------------------------------------
+
+def to_shardings(specs, mesh: Mesh):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    """device_put every param leaf to its partitioned layout."""
+    return jax.device_put(params, to_shardings(param_specs(cfg, mesh), mesh))
+
+
+def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
+    return jax.device_put(cache, to_shardings(cache_specs(cfg, mesh), mesh))
+
+
+# ---------------------------------------------------------------------------
+# HLO inspection (test/debug aid: verify collective placement, SURVEY.md §7)
+# ---------------------------------------------------------------------------
+
+def compiled_hlo(fn, *args, mesh: Optional[Mesh] = None, **jit_kw) -> str:
+    """Lower+compile fn under `mesh` and return optimized HLO text."""
+    jfn = jax.jit(fn, **jit_kw)
+    if mesh is not None:
+        with mesh:
+            lowered = jfn.lower(*args)
+    else:
+        lowered = jfn.lower(*args)
+    return lowered.compile().as_text()
+
+
+def count_collectives(hlo: str) -> Dict[str, int]:
+    """Count collective ops in optimized HLO text, keyed by op name."""
+    ops = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+           "collective-permute")
+    counts = {op: 0 for op in ops}
+    for line in hlo.splitlines():
+        s = line.lstrip()
+        # count op *instances*: lines like `%all-reduce.3 = ...` or
+        # `ROOT %all-gather ...`, not parameter references. Async pairs
+        # (`-start`/`-done`) are one logical collective: skip `-done`.
+        if "=" not in s:
+            continue
+        lhs = s.split("=", 1)[0]
+        if "-done" in lhs:
+            continue
+        for op in ops:
+            if op in lhs:
+                counts[op] += 1
+    return counts
