@@ -43,6 +43,8 @@ class ModelConfig:
     # MoE (mixtral)
     num_experts: int = 0              # 0 => dense FFN
     num_experts_per_tok: int = 2
+    moe_impl: str = "dense"           # "dense" | "ep" (GShard dispatch)
+    moe_capacity_factor: float = 2.0  # per-expert slots multiplier (ep)
 
     # numerics
     dtype: str = "bfloat16"           # activation/weight compute dtype

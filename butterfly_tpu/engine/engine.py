@@ -44,22 +44,33 @@ class InferenceEngine:
     """
 
     def __init__(self, model: Model, params, runtime: Optional[RuntimeConfig] = None,
-                 param_shardings=None, cache_sharding=None):
+                 mesh=None, num_microbatches: Optional[int] = None):
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
         self.params = params
+        self.mesh = mesh
+        # One forward callable for every step: the plain single-program
+        # forward, or the GPipe pipeline when the mesh has stage > 1.
+        if mesh is not None and mesh.shape.get("stage", 1) > 1:
+            from butterfly_tpu.parallel.pipeline import pipeline_forward
+            fwd = lambda p, t, c, pos=None: pipeline_forward(  # noqa: E731
+                p, self.cfg, t, c, mesh, num_microbatches, pos)
+        else:
+            fwd = lambda p, t, c, pos=None: forward(  # noqa: E731
+                p, self.cfg, t, c, pos)
+        self._fwd = fwd
         self._prefill = jax.jit(
-            partial(_prefill_step, self.cfg),
+            partial(_prefill_step, fwd),
             donate_argnums=(2,),
         )
         self._decode = jax.jit(
-            partial(_decode_step, self.cfg),
+            partial(_decode_step, fwd),
             static_argnums=(4,),
             donate_argnums=(2,),
         )
         self._generate_fused = jax.jit(
-            partial(_generate_fused, self.cfg),
+            partial(_generate_fused, fwd),
             static_argnums=(4, 5),
             donate_argnums=(2,),
         )
@@ -83,6 +94,14 @@ class InferenceEngine:
                  seed: int = 0, fused: bool = True) -> GenerateResult:
         """End-to-end batched generation from python-list prompts."""
         sp = sp or SamplingParams()
+        n_real = len(prompts)
+        # The mesh's data axis shards the batch dim: pad the request count
+        # to a multiple of it (dummy rows are stripped from the result).
+        if self.mesh is not None:
+            dp = self.mesh.shape.get("data", 1)
+            if n_real % dp != 0:
+                prompts = list(prompts) + [list(prompts[0])] * (
+                    dp - n_real % dp)
         tokens, true_lens = pad_prompts(prompts)
         B = tokens.shape[0]
         total = tokens.shape[1] + sp.max_new_tokens
@@ -93,53 +112,63 @@ class InferenceEngine:
                 f"max_seq_len ({self.cfg.max_seq_len})")
         max_seq = max(self.runtime.max_seq_len, total)
         cache = self.new_cache(B, max_seq)
+        if self.mesh is not None:
+            from butterfly_tpu.parallel.partition import shard_cache
+            cache = shard_cache(cache, self.cfg, self.mesh)
         key, first_key, loop_key = jax.random.split(jax.random.PRNGKey(seed), 3)
 
-        logits, cache = self.prefill(jnp.asarray(tokens), jnp.asarray(true_lens),
-                                     cache)
-        first = sample(logits, first_key, sp)
+        with self._mesh_ctx():
+            logits, cache = self.prefill(jnp.asarray(tokens),
+                                         jnp.asarray(true_lens), cache)
+            first = sample(logits, first_key, sp)
 
-        if fused:
-            out, lens = self._generate_fused(self.params, first, cache, loop_key,
-                                             sp, sp.max_new_tokens)
-            out, lens = np.asarray(out), np.asarray(lens)
-        else:
-            toks = [np.asarray(first)]
-            cur = first
-            key = loop_key
-            for _ in range(sp.max_new_tokens - 1):
-                key, sub = jax.random.split(key)
-                cur, cache, _ = self.decode(cur, cache, sub, sp)
-                toks.append(np.asarray(cur))
-            out = np.stack(toks, axis=1)
-            lens = _stop_lengths(out, sp.stop_token)
-            out = _mask_after_stop(out, lens, sp.stop_token)
-        return GenerateResult(tokens=out, lengths=lens,
-                              prompt_lengths=np.asarray(true_lens))
+            if fused:
+                out, lens = self._generate_fused(self.params, first, cache,
+                                                 loop_key, sp,
+                                                 sp.max_new_tokens)
+                out, lens = np.asarray(out), np.asarray(lens)
+            else:
+                toks = [np.asarray(first)]
+                cur = first
+                key = loop_key
+                for _ in range(sp.max_new_tokens - 1):
+                    key, sub = jax.random.split(key)
+                    cur, cache, _ = self.decode(cur, cache, sub, sp)
+                    toks.append(np.asarray(cur))
+                out = np.stack(toks, axis=1)
+                lens = _stop_lengths(out, sp.stop_token)
+                out = _mask_after_stop(out, lens, sp.stop_token)
+        return GenerateResult(tokens=out[:n_real], lengths=lens[:n_real],
+                              prompt_lengths=np.asarray(true_lens)[:n_real])
+
+    def _mesh_ctx(self):
+        import contextlib
+        return jax.set_mesh(self.mesh) if self.mesh is not None \
+            else contextlib.nullcontext()
 
 
 # ---------------------------------------------------------------------------
 # jitted step functions (module-level so jit caches persist across engines)
 # ---------------------------------------------------------------------------
 
-def _prefill_step(cfg: ModelConfig, params, tokens, cache, true_lens):
+def _prefill_step(fwd, params, tokens, cache, true_lens):
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    logits, cache = forward(params, cfg, tokens, cache, positions)
+    logits, cache = fwd(params, tokens, cache, positions)
     # gather last *real* token's logits; fix per-seq lengths
     last = jnp.take_along_axis(logits, (true_lens - 1)[:, None, None], axis=1)
     cache = KVCache(cache.k, cache.v, true_lens.astype(jnp.int32))
     return last[:, 0, :], cache
 
 
-def _decode_step(cfg: ModelConfig, params, token, cache, key, sp: SamplingParams):
-    logits, cache = forward(params, cfg, token[:, None], cache)
+def _decode_step(fwd, params, token, cache, key, sp: SamplingParams):
+    logits, cache = fwd(params, token[:, None], cache)
     key, sub = jax.random.split(key)
     nxt = sample(logits[:, -1, :], sub, sp)
     return nxt, cache, key
 
 
-def _generate_fused(cfg: ModelConfig, params, first, cache, key,
+def _generate_fused(fwd, params, first, cache, key,
                     sp: SamplingParams, max_new: int):
     """lax.scan over decode steps — the whole generation is one XLA program.
 
@@ -149,7 +178,7 @@ def _generate_fused(cfg: ModelConfig, params, first, cache, key,
     """
     def body(carry, _):
         cur, cache, key, done = carry
-        logits, cache = forward(params, cfg, cur[:, None], cache)
+        logits, cache = fwd(params, cur[:, None], cache)
         key, sub = jax.random.split(key)
         nxt = sample(logits[:, -1, :], sub, sp)
         nxt = jnp.where(done, cur, nxt)

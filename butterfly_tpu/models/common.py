@@ -157,16 +157,11 @@ def attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array,
 # Layer bodies
 # ---------------------------------------------------------------------------
 
-def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
-                    ck: jax.Array, cv: jax.Array,
-                    positions: jax.Array, mask: jax.Array,
-                    cos: jax.Array, sin: jax.Array
-                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One attention sublayer with cache update.
-
-    x: [B,T,D]; ck/cv: [B,S,Kv,H]; positions: [B,T]; mask: [B,T,S].
-    """
-    B, T, D = x.shape
+def qkv_proj(x: jax.Array, p: Params, cfg: ModelConfig,
+             cos: jax.Array, sin: jax.Array
+             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """QKV projections (+bias, +rope). x: [B,T,D] -> q [B,T,Nq,H],
+    k/v [B,T,Kv,H]. Shared by the contiguous and paged attention paths."""
     q = jnp.einsum("btd,dnh->btnh", x, p["wq"])
     k = jnp.einsum("btd,dkh->btkh", x, p["wk"])
     v = jnp.einsum("btd,dkh->btkh", x, p["wv"])
@@ -177,14 +172,31 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
     if cfg.pos_embedding == "rope":
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+    return q, k, v
 
-    start = positions[:, 0]  # write offset per sequence
-    ck, cv = update_cache_layer(ck, cv, k, v, start)
-    out = attend(q, ck, cv, mask, cfg)
+
+def attn_output(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Output projection of the attention sublayer. out: [B,T,Nq,H]."""
     out = jnp.einsum("btnh,nhd->btd", out, p["wo"])
     if cfg.use_bias:
         out = out + p["bo"]
-    return out, ck, cv
+    return out
+
+
+def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
+                    ck: jax.Array, cv: jax.Array,
+                    positions: jax.Array, mask: jax.Array,
+                    cos: jax.Array, sin: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One attention sublayer with contiguous-cache update.
+
+    x: [B,T,D]; ck/cv: [B,S,Kv,H]; positions: [B,T]; mask: [B,T,S].
+    """
+    q, k, v = qkv_proj(x, p, cfg, cos, sin)
+    start = positions[:, 0]  # write offset per sequence
+    ck, cv = update_cache_layer(ck, cv, k, v, start)
+    out = attend(q, ck, cv, mask, cfg)
+    return attn_output(out, p, cfg), ck, cv
 
 
 def mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
@@ -241,7 +253,11 @@ def transformer_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
     else:
         h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
     if cfg.is_moe:
-        ffn_out = moe_block(h, lp["moe"], cfg)
+        if cfg.moe_impl == "ep":
+            from butterfly_tpu.parallel.expert import moe_block_ep
+            ffn_out = moe_block_ep(h, lp["moe"], cfg)
+        else:
+            ffn_out = moe_block(h, lp["moe"], cfg)
     else:
         ffn_out = mlp_block(h, lp["mlp"], cfg)
     x = x + ffn_out
@@ -263,18 +279,11 @@ def make_mask(positions: jax.Array, S: int) -> jax.Array:
     return j <= positions[:, :, None]
 
 
-def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
-            cache: KVCache, positions: Optional[jax.Array] = None
-            ) -> Tuple[jax.Array, KVCache]:
-    """Run the model over `tokens` [B,T], reading/updating `cache`.
-
-    positions defaults to cache.length[:,None] + arange(T) (append).
-    Returns (logits [B,T,V] float32, updated cache).
-    """
+def embed_tokens(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                 positions: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Token (+pos) embedding. Returns (x [B,T,D], cos, sin)."""
     B, T = tokens.shape
-    if positions is None:
-        positions = cache.length[:, None] + jnp.arange(T)[None, :]
-
     compute_dtype = jnp.dtype(cfg.dtype)
     x = params["embed"]["tok"].astype(compute_dtype)[tokens]
     if cfg.pos_embedding == "learned":
@@ -282,8 +291,20 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         cos = sin = jnp.zeros((B, T, cfg.head_dim // 2), jnp.float32)
     else:
         cos, sin = rope_freqs(cfg, positions)
+    return x, cos, sin
 
-    mask = make_mask(positions, cache.max_seq)
+
+def scan_layers(layer_params: Params, cfg: ModelConfig, x: jax.Array,
+                k: jax.Array, v: jax.Array, positions: jax.Array,
+                mask: jax.Array, cos: jax.Array, sin: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """lax.scan of transformer_layer over layer-stacked leaves.
+
+    Works on any leading-layer-count slice (full model, or one pipeline
+    stage's slice — parallel/pipeline.py scans each stage's local layers
+    with this same body). Returns (x, new_k, new_v).
+    """
+    compute_dtype = jnp.dtype(cfg.dtype)
 
     def body(x, scanned):
         lp, ck, cv = scanned
@@ -292,8 +313,13 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                       positions, mask, cos, sin)
         return x, (ck, cv)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, (new_k, new_v) = lax.scan(body, x, (layer_params, k, v))
+    return x, new_k, new_v
 
+
+def final_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    """Final norm + LM head. Returns logits [B,T,V] float32."""
+    compute_dtype = jnp.dtype(cfg.dtype)
     if cfg.arch == "gpt2":
         x = layer_norm(x, params["final_norm"]["scale"],
                        params["final_norm"]["bias"], cfg.norm_eps)
@@ -306,9 +332,28 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     else:
         logits = jnp.einsum("btd,dv->btv", x,
                             params["lm_head"].astype(compute_dtype))
+    return logits.astype(jnp.float32)
 
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            cache: KVCache, positions: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, KVCache]:
+    """Run the model over `tokens` [B,T], reading/updating `cache`.
+
+    positions defaults to cache.length[:,None] + arange(T) (append).
+    Returns (logits [B,T,V] float32, updated cache).
+    """
+    B, T = tokens.shape
+    if positions is None:
+        positions = cache.length[:, None] + jnp.arange(T)[None, :]
+
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    mask = make_mask(positions, cache.max_seq)
+    x, new_k, new_v = scan_layers(params["layers"], cfg, x, cache.k, cache.v,
+                                  positions, mask, cos, sin)
+    logits = final_logits(params, cfg, x)
     new_len = cache.length + T
-    return logits.astype(jnp.float32), KVCache(new_k, new_v, new_len)
+    return logits, KVCache(new_k, new_v, new_len)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,102 @@
+"""Expert parallelism: GShard-style capacity-based MoE dispatch/combine.
+
+TPU-native realization of the reference's MoE expert-parallel requirement
+(BASELINE.json configs[3], Mixtral-8x7B over ICI; the reference itself has
+no implementation — SURVEY.md §0). Instead of NCCL all_to_all calls on
+token buffers, the dispatch and combine are *einsums with one-hot dispatch
+tensors*; with
+
+  * tokens sharded over `data` (batch dim), and
+  * experts sharded over `expert` (leading E dim of w_gate/w_up/w_down),
+
+GSPMD lowers the dispatch einsum to the all-to-all that moves token
+activations to their experts' devices and the combine einsum to the
+reverse — the canonical TPU MoE lowering (GShard, Mesh-TF lineage).
+
+Capacity: each expert processes at most C = ceil(cf * k * T / E) tokens
+per sequence; overflow tokens are dropped (their FFN contribution is zero,
+residual passes through — standard Switch/GShard semantics). With
+cf >= E / k... cf large enough that C >= k*T, nothing drops and the result
+equals the dense reference `models.common.moe_block` exactly — that is the
+parity test. Inference-only: no load-balancing aux loss.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from butterfly_tpu.core.config import ModelConfig
+from butterfly_tpu.models.common import ACTIVATIONS, Params
+
+
+def _constrain(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint iff a mesh with the spec's axes is active."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    names = set()
+    for part in spec:
+        if part is None:
+            continue
+        names.update(part if isinstance(part, tuple) else (part,))
+    if not names.issubset(set(mesh.axis_names)):
+        return x
+    return lax.with_sharding_constraint(x, spec)
+
+
+def expert_capacity(cfg: ModelConfig, tokens_per_seq: int) -> int:
+    """Per-sequence per-expert token slots."""
+    c = math.ceil(cfg.moe_capacity_factor * cfg.num_experts_per_tok
+                  * tokens_per_seq / cfg.num_experts)
+    return max(1, min(c, cfg.num_experts_per_tok * tokens_per_seq))
+
+
+def moe_block_ep(x: jax.Array, p: Params, cfg: ModelConfig,
+                 capacity: Optional[int] = None) -> jax.Array:
+    """Expert-parallel MoE FFN: dispatch -> expert SwiGLU -> combine.
+
+    x: [B,T,D]. Experts' weight leaves p["w_*"]: [E,D,F]/[E,F,D] (one
+    layer's slice — the layer scan strips the L dim). Returns [B,T,D].
+    """
+    B, T, D = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    C = capacity or expert_capacity(cfg, T)
+
+    router_logits = jnp.einsum("btd,de->bte", x,
+                               p["router"]).astype(jnp.float32)
+    gates, idx = lax.top_k(router_logits, k)          # [B,T,k]
+    gates = jax.nn.softmax(gates, axis=-1)
+
+    # Slot assignment: expert e takes tokens in (t, k)-priority order.
+    emask = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [B,T,k,E]
+    flat = emask.reshape(B, T * k, E)
+    pos = jnp.cumsum(flat, axis=1) - 1                 # position in expert
+    pos = pos.reshape(B, T, k, E)
+    keep = (pos < C) & (emask > 0)                     # overflow -> drop
+    emask = emask.astype(jnp.float32)
+
+    # dispatch[b,t,e,c] = 1 iff token (b,t) occupies slot c of expert e
+    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32)   # [B,T,k,E,C]
+    dispatch = jnp.einsum("btke,btkec->btec",
+                          keep.astype(jnp.float32) * emask, slot)
+    combine = jnp.einsum("btk,btke,btkec->btec",
+                         gates, keep.astype(jnp.float32) * emask, slot)
+
+    # The all-to-all: tokens (data-sharded) -> expert-major layout.
+    xin = jnp.einsum("btec,btd->ebcd", dispatch.astype(x.dtype), x)
+    xin = _constrain(xin, P("expert", "data", None, None))
+
+    act = ACTIVATIONS[cfg.act]
+    g = jnp.einsum("ebcd,edf->ebcf", xin, p["w_gate"])
+    u = jnp.einsum("ebcd,edf->ebcf", xin, p["w_up"])
+    y = jnp.einsum("ebcf,efd->ebcd", act(g) * u, p["w_down"])
+    y = _constrain(y, P("expert", "data", None, None))
+
+    # Reverse all-to-all + weighted combine back to token-major layout.
+    out = jnp.einsum("btec,ebcd->btd", combine.astype(y.dtype), y)
+    return _constrain(out, P("data", None, None))

@@ -105,8 +105,10 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Specs:
 
 
 def cache_specs(cfg: ModelConfig, mesh: Mesh) -> KVCache:
-    """Specs for the KVCache pytree [L,B,S,Kv,H]: batch x data, heads x tensor."""
-    kv = P(None, _div_any(mesh, "data"), None,
+    """Specs for the KVCache pytree [L,B,S,Kv,H]: layers x stage (mirrors
+    the param layout so each pipeline stage holds only its own layers'
+    cache), batch x data, kv-heads x tensor."""
+    kv = P(_div(cfg.num_layers, mesh, "stage"), _div_any(mesh, "data"), None,
            _div(cfg.num_kv_heads, mesh, "tensor"), None)
     return KVCache(k=kv, v=kv, length=P(_div_any(mesh, "data")))
 

@@ -1,0 +1,149 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `stage` mesh axis.
+
+TPU-native realization of the reference's planned pipeline-stage send/recv
+(/root/reference/CLAUDE.md:19-22 names the layers; no implementation exists
+— SURVEY.md §0). Instead of point-to-point NCCL send/recv between stage
+processes, the whole pipeline is ONE SPMD program:
+
+* layer-stacked params/cache keep their leading L dim; `shard_map` manual
+  over `stage` gives each stage its local [L/S, ...] slice;
+* stage handoff is `lax.ppermute` (XLA collective-permute — on TPU this
+  rides neighbor ICI links, the canonical pipeline transport);
+* the microbatch schedule is a `lax.scan` over M + S - 1 ticks (GPipe):
+  tick t has stage s working on microbatch m = t - s; invalid (bubble)
+  ticks compute on garbage and are masked out of all writes;
+* `tensor`/`data` axes stay under GSPMD auto partitioning *inside* the
+  body (shard_map axis_names={'stage'}), so TPxPP composes without manual
+  collectives: the per-stage einsums still get their Megatron all-reduces
+  from the partitioner's specs.
+
+Bubble fraction is (S-1)/(M+S-1); pick num_microbatches >= 4*S for decode
+throughput parity with the north star (BASELINE.json configs[2]).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from butterfly_tpu.core.config import ModelConfig
+from butterfly_tpu.models.common import (
+    KVCache, Params, embed_tokens, final_logits, make_mask, scan_layers)
+
+
+def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                     cache: KVCache, mesh: Mesh,
+                     num_microbatches: Optional[int] = None,
+                     positions: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, KVCache]:
+    """Full forward with the layer stack pipelined over `stage`.
+
+    Embedding and LM head run under plain GSPMD (they are outside the
+    stage loop; on a real pod they live with stage 0 / stage S-1 layer
+    weights — replicated here, cheap relative to the stack). Requires
+    cfg.num_layers % S == 0 and batch % num_microbatches == 0.
+    """
+    S = mesh.shape["stage"]
+    B, T = tokens.shape
+    if positions is None:
+        positions = cache.length[:, None] + jnp.arange(T)[None, :]
+    if S == 1:
+        from butterfly_tpu.models.common import forward
+        return forward(params, cfg, tokens, cache, positions)
+
+    M = num_microbatches or _default_microbatches(B, S)
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if cfg.num_layers % S != 0:
+        raise ValueError(f"{cfg.num_layers} layers not divisible by {S} stages")
+
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    mask = make_mask(positions, cache.max_seq)
+
+    body = partial(_pipeline_body, cfg=cfg, S=S, M=M)
+    # Manual over `stage` only: layer-stacked leaves and the cache split
+    # their leading L dim; activations/masks are replicated over stage.
+    # tensor/data stay auto (GSPMD) inside.
+    layer_in = jax.tree.map(lambda _: P("stage"), params["layers"])
+    pipe = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(layer_in, P("stage"), P("stage"),
+                  P(), P(), P(), P(), P()),
+        out_specs=(P(), P("stage"), P("stage")),
+        axis_names={"stage"}, check_vma=False)
+    y, new_k, new_v = pipe(params["layers"], cache.k, cache.v,
+                           x, positions, mask, cos, sin)
+
+    logits = final_logits(params, cfg, y)
+    return logits, KVCache(new_k, new_v, cache.length + T)
+
+
+def _default_microbatches(B: int, S: int) -> int:
+    """Largest divisor of B that is <= 2*S (keeps the bubble small without
+    violating B % M == 0 for any batch size)."""
+    best = 1
+    for m in range(1, min(B, 2 * S) + 1):
+        if B % m == 0:
+            best = m
+    return best
+
+
+def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
+                   *, cfg: ModelConfig, S: int, M: int):
+    """Per-stage GPipe schedule (runs inside shard_map, manual over stage).
+
+    layers/ck/cv are the local [L/S, ...] stage slice; x [B,T,D] etc. are
+    full-batch and replicated over stage.
+    """
+    stage = lax.axis_index("stage")
+    B = x.shape[0]
+    mb = B // M
+
+    # [M, mb, ...] microbatch views
+    xs = x.reshape(M, mb, *x.shape[1:])
+    pos_mb = positions.reshape(M, mb, *positions.shape[1:])
+    mask_mb = mask.reshape(M, mb, *mask.shape[1:])
+    cos_mb = cos.reshape(M, mb, *cos.shape[1:])
+    sin_mb = sin.reshape(M, mb, *sin.shape[1:])
+
+    state0 = jnp.zeros_like(xs[0])          # activation entering this stage
+    out0 = jnp.zeros_like(xs)               # last stage's results
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        state, ck, cv, outs = carry
+        m = t - stage                        # microbatch this stage works on
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+
+        inp = jnp.where(stage == 0, xs[jnp.clip(t, 0, M - 1)], state)
+        ck_m = lax.dynamic_slice_in_dim(ck, mc * mb, mb, axis=1)
+        cv_m = lax.dynamic_slice_in_dim(cv, mc * mb, mb, axis=1)
+
+        y, nk, nv = scan_layers(layers, cfg, inp, ck_m, cv_m,
+                                pos_mb[mc], mask_mb[mc], cos_mb[mc],
+                                sin_mb[mc])
+
+        # write back cache/output only on valid (non-bubble) ticks
+        nk = jnp.where(valid, nk, ck_m)
+        nv = jnp.where(valid, nv, cv_m)
+        ck = lax.dynamic_update_slice_in_dim(ck, nk, mc * mb, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(cv, nv, mc * mb, axis=1)
+
+        rec = jnp.where(valid & (stage == S - 1), y, outs[mc])
+        outs = lax.dynamic_update_index_in_dim(outs, rec, mc, axis=0)
+
+        state = lax.ppermute(y, "stage", fwd_perm)
+        return (state, ck, cv, outs), None
+
+    (_, ck, cv, outs), _ = lax.scan(
+        tick, (state0, ck, cv, out0), jnp.arange(M + S - 1))
+
+    # outs is only meaningful on the last stage; replicate it via psum.
+    outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+    outs = lax.psum(outs, "stage")
+    return outs.reshape(B, *x.shape[1:]), ck, cv
