@@ -1,0 +1,184 @@
+"""Paged KV cache: block-table layout for continuous batching.
+
+Realizes BASELINE.json configs[4] ("continuous batching + paged KV cache");
+the reference has no implementation (SURVEY.md §0). Design (vLLM-style
+semantics, TPU-native mechanics):
+
+* One global page pool per layer stack: k/v_pages [L, P, page, Kv, H] in
+  HBM. Sequences own pages through a block table [slots, max_pages] of
+  page ids; page P-1 is reserved as the null page (block tables are
+  initialized to it, so gathers from unallocated slots read zeros and the
+  causal mask hides them).
+* Token writes are scatters (`.at[...].set`) at (page_table[slot, t//page],
+  t%page) — XLA Scatter keeps the pool HBM-resident, the paged analogue of
+  the contiguous cache's DynamicUpdateSlice.
+* Attention reads gather each slot's pages back into a contiguous
+  [B, S_max, Kv, H] view per layer (XLA Gather). This reference path reads
+  the same bytes a contiguous cache would; the Pallas paged-attention
+  kernel (ops/) replaces gather+attend for decode so only *used* pages are
+  touched.
+* Page allocation/free is host-side (cache/allocator.py) — the device
+  never sees dynamic shapes, only a static pool and int32 tables.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
+
+
+class PagedKVCache(NamedTuple):
+    k_pages: jax.Array     # [L, P, page, Kv, H]
+    v_pages: jax.Array     # [L, P, page, Kv, H]
+    page_table: jax.Array  # [slots, max_pages] int32, null = P-1
+    lengths: jax.Array     # [slots] int32 tokens written per slot
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def null_page(self) -> int:
+        return self.k_pages.shape[1] - 1
+
+    @property
+    def max_seq(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+    @property
+    def num_slots(self) -> int:
+        return self.page_table.shape[0]
+
+
+def init_paged_cache(cfg: ModelConfig, runtime: RuntimeConfig,
+                     dtype: Optional[jnp.dtype] = None) -> PagedKVCache:
+    """Pool sized from the runtime config (+1 reserved null page)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    page = runtime.page_size
+    max_pages = -(-runtime.max_seq_len // page)
+    P = runtime.num_pages or runtime.max_batch_size * max_pages
+    P += 1  # null page
+    shape = (cfg.num_layers, P, page, cfg.num_kv_heads, cfg.head_dim)
+    return PagedKVCache(
+        k_pages=jnp.zeros(shape, dtype),
+        v_pages=jnp.zeros(shape, dtype),
+        page_table=jnp.full((runtime.max_batch_size, max_pages), P - 1,
+                            jnp.int32),
+        lengths=jnp.zeros((runtime.max_batch_size,), jnp.int32),
+    )
+
+
+def write_paged_layer(k_pages: jax.Array, v_pages: jax.Array,
+                      page_table: jax.Array, k: jax.Array, v: jax.Array,
+                      start: jax.Array,
+                      active: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Scatter new tokens into one layer's page pool.
+
+    k_pages/v_pages: [P, page, Kv, H]; k/v: [B, T, Kv, H] (T new tokens per
+    slot); start: [B] first absolute position of each slot's new tokens.
+    Inactive slots' writes are redirected to the null page. Positions past
+    a slot's allocated pages must not occur for active slots (the host
+    allocator guarantees capacity before scheduling the step).
+    """
+    Pp, page, Kv, H = k_pages.shape
+    B, T = k.shape[0], k.shape[1]
+    pos = start[:, None] + jnp.arange(T)[None, :]          # [B,T] absolute
+    page_idx = jnp.take_along_axis(page_table, pos // page, axis=1)  # [B,T]
+    if active is not None:
+        page_idx = jnp.where(active[:, None], page_idx, Pp - 1)
+    offset = pos % page                                     # [B,T]
+    flat_pages = page_idx.reshape(-1)
+    flat_off = offset.reshape(-1)
+    kf = k.reshape(B * T, Kv, H).astype(k_pages.dtype)
+    vf = v.reshape(B * T, Kv, H).astype(v_pages.dtype)
+    k_pages = k_pages.at[flat_pages, flat_off].set(kf)
+    v_pages = v_pages.at[flat_pages, flat_off].set(vf)
+    return k_pages, v_pages
+
+
+def gather_paged_layer(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """One layer's pages -> contiguous [B, S_max, Kv, H] view (XLA Gather)."""
+    Pp, page, Kv, H = pages.shape
+    B, max_pages = page_table.shape
+    out = pages[page_table]                 # [B, max_pages, page, Kv, H]
+    return out.reshape(B, max_pages * page, Kv, H)
+
+
+# ---------------------------------------------------------------------------
+# Paged forward pass (reference path; Pallas decode kernel lives in ops/)
+# ---------------------------------------------------------------------------
+
+def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
+                  cache: PagedKVCache,
+                  positions: Optional[jax.Array] = None,
+                  active: Optional[jax.Array] = None):
+    """Forward over [B,T] tokens against the paged cache.
+
+    B must equal cache.num_slots (serving: one row per slot). `active`
+    [B] bool masks slots with no live request: their lengths don't
+    advance and their writes land on pages only they own (admission wrote
+    their table), so garbage never leaks across requests. Returns
+    (logits [B,T,V], updated cache).
+    """
+    from butterfly_tpu.models.common import (
+        attend, attn_output, embed_tokens, final_logits, make_mask,
+        mlp_block, moe_block, qkv_proj, rms_norm, layer_norm)
+    import jax as _jax
+
+    B, T = tokens.shape
+    if positions is None:
+        positions = cache.lengths[:, None] + jnp.arange(T)[None, :]
+    if active is None:
+        active = jnp.ones((B,), bool)
+
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    mask = make_mask(positions, cache.max_seq)
+    mask = mask & active[:, None, None]
+    compute_dtype = jnp.dtype(cfg.dtype)
+    start = positions[:, 0]
+
+    def body(x, scanned):
+        lp, kp, vp = scanned
+        lp = _jax.tree.map(lambda a: a.astype(compute_dtype), lp)
+        if cfg.arch == "gpt2":
+            h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"],
+                           cfg.norm_eps)
+        else:
+            h = rms_norm(x, lp["ln1"]["scale"], cfg.norm_eps)
+        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+        kp, vp = write_paged_layer(kp, vp, cache.page_table, k, v, start,
+                                   active)
+        ck = gather_paged_layer(kp, cache.page_table)
+        cv = gather_paged_layer(vp, cache.page_table)
+        out = attend(q, ck, cv, mask, cfg)
+        x = x + attn_output(out, lp["attn"], cfg)
+
+        if cfg.arch == "gpt2":
+            h = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"],
+                           cfg.norm_eps)
+        else:
+            h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
+        if cfg.is_moe:
+            if cfg.moe_impl == "ep":
+                from butterfly_tpu.parallel.expert import moe_block_ep
+                x = x + moe_block_ep(h, lp["moe"], cfg)
+            else:
+                x = x + moe_block(h, lp["moe"], cfg)
+        else:
+            x = x + mlp_block(h, lp["mlp"], cfg)
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache.k_pages, cache.v_pages))
+    logits = final_logits(params, cfg, x)
+    new_len = jnp.where(active, cache.lengths + T, cache.lengths)
+    return logits, PagedKVCache(new_k, new_v, cache.page_table, new_len)

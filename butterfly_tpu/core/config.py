@@ -177,6 +177,8 @@ class RuntimeConfig:
     scheduler: str = "continuous"     # "continuous" | "static"
     max_queue: int = 256
     decode_steps_per_tick: int = 1
+    top_k: int = 0                    # serving-wide sampling filters
+    top_p: float = 1.0
     port: int = 8000
 
     def replace(self, **kw) -> "RuntimeConfig":

@@ -1,0 +1,147 @@
+"""Slot-based serving engine over the paged KV cache.
+
+The continuous-batching scheduler (sched/scheduler.py) drives two jitted
+device programs, both static-shape so batch composition changes never
+recompile (SURVEY.md §7 "hard parts"):
+
+* `prefill_slot`: one request's padded prompt [1, Tbucket] against the
+  shared page pool, targeting only that request's block-table row. Prompt
+  lengths are bucketed (next power of two) so at most log2(max_seq)
+  prefill programs ever compile.
+* `decode_active`: one token for ALL slots [S,1]; inactive slots are
+  masked via `active` (their lengths don't advance, their writes land on
+  the null page). Sampling is vectorized with per-slot temperature so
+  requests with different sampling settings batch together.
+
+Parity contract: tests/test_serving.py checks token-for-token equality
+with InferenceEngine.generate on the contiguous cache.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.cache.paged import (
+    PagedKVCache, init_paged_cache, paged_forward)
+from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
+from butterfly_tpu.models.common import Model
+
+
+def bucket_len(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def sample_batched(logits: jax.Array, key: jax.Array, temps: jax.Array,
+                   top_k: int, top_p: float) -> jax.Array:
+    """Per-slot-temperature sampling: temp 0 rows are greedy. [S,V]->[S]."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    scaled = logits / safe_t
+    if top_k > 0:
+        from butterfly_tpu.engine.sampling import _apply_top_k
+        scaled = _apply_top_k(scaled, top_k)
+    if top_p < 1.0:
+        from butterfly_tpu.engine.sampling import _apply_top_p
+        scaled = _apply_top_p(scaled, top_p)
+    drawn = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, drawn, greedy)
+
+
+class ServingEngine:
+    """Device-side half of the serving stack (host half: sched/)."""
+
+    def __init__(self, model: Model, params,
+                 runtime: Optional[RuntimeConfig] = None, mesh=None):
+        self.model = model
+        self.cfg = model.cfg
+        self.runtime = runtime or RuntimeConfig()
+        self.params = params
+        self.mesh = mesh
+        self.cache = init_paged_cache(self.cfg, self.runtime)
+        self._prefill = jax.jit(
+            partial(_prefill_slot, self.cfg), donate_argnums=(2, 3))
+        self._decode = jax.jit(
+            partial(_decode_all, self.cfg), static_argnums=(5, 6),
+            donate_argnums=(2,))
+
+    @property
+    def num_slots(self) -> int:
+        return self.runtime.max_batch_size
+
+    def set_table_row(self, slot: int, pages) -> None:
+        """Host allocator -> device block table (one small row transfer)."""
+        row = np.full((self.cache.page_table.shape[1],),
+                      self.cache.null_page, np.int32)
+        row[:len(pages)] = pages
+        self.cache = self.cache._replace(
+            page_table=self.cache.page_table.at[slot].set(jnp.asarray(row)))
+
+    def reset_slot(self, slot: int) -> None:
+        self.cache = self.cache._replace(
+            page_table=self.cache.page_table.at[slot].set(
+                self.cache.null_page),
+            lengths=self.cache.lengths.at[slot].set(0))
+
+    def prefill_slot(self, slot: int, prompt: list[int]) -> jax.Array:
+        """Run one request's prompt; returns last-token logits [V]."""
+        T = bucket_len(len(prompt))
+        tokens = np.zeros((1, T), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        logits, k_pages, v_pages = self._prefill(
+            self.params, jnp.asarray(tokens), self.cache.k_pages,
+            self.cache.v_pages, self.cache.page_table[slot][None],
+            jnp.asarray([len(prompt)], jnp.int32))
+        self.cache = self.cache._replace(
+            k_pages=k_pages, v_pages=v_pages,
+            lengths=self.cache.lengths.at[slot].set(len(prompt)))
+        return logits[0]
+
+    def decode_active(self, tokens: np.ndarray, active: np.ndarray,
+                      temps: np.ndarray, key: jax.Array
+                      ) -> Tuple[np.ndarray, jax.Array]:
+        """One decode step for every slot; returns (next tokens [S], logits)."""
+        nxt, logits, cache = self._decode(
+            self.params, jnp.asarray(tokens), self.cache,
+            jnp.asarray(active), jnp.asarray(temps),
+            self.runtime_top_k, self.runtime_top_p, key)
+        self.cache = cache
+        return np.asarray(nxt), logits
+
+    # static sampling knobs (per-slot temps are dynamic)
+    @property
+    def runtime_top_k(self) -> int:
+        return self.runtime.top_k
+
+    @property
+    def runtime_top_p(self) -> float:
+        return self.runtime.top_p
+
+
+def _prefill_slot(cfg: ModelConfig, params, tokens, k_pages, v_pages,
+                  table_row, true_len):
+    """[1,T] prompt against the slot's table row; pool-wide scatter."""
+    from butterfly_tpu.models.common import (
+        embed_tokens, final_logits, make_mask)
+    cache1 = PagedKVCache(k_pages, v_pages, table_row,
+                          jnp.zeros((1,), jnp.int32))
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    logits, cache1 = paged_forward(params, cfg, tokens, cache1, positions)
+    last = jnp.take_along_axis(logits, (true_len - 1)[:, None, None], axis=1)
+    return last[:, 0, :], cache1.k_pages, cache1.v_pages
+
+
+def _decode_all(cfg: ModelConfig, params, tokens, cache: PagedKVCache,
+                active, temps, top_k: int, top_p: float, key):
+    logits, cache = paged_forward(params, cfg, tokens[:, None], cache,
+                                  active=active)
+    last = logits[:, -1, :]
+    nxt = sample_batched(last, key, temps, top_k, top_p)
+    return nxt, last, cache

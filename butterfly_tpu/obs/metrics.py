@@ -1,0 +1,71 @@
+"""Prometheus-format metrics for the serving endpoint.
+
+Reports BASELINE.json's metrics of record directly (tokens/sec/chip, TTFT
+percentiles, queue depth, KV-page occupancy — SURVEY.md §5). The reference
+only ever *planned* observability (/root/reference/CLAUDE.md:42).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+PREFIX = "butterfly"
+
+HELP = {
+    "requests_total": "Requests submitted",
+    "requests_finished": "Requests completed",
+    "tokens_generated_total": "Tokens generated across all requests",
+    "preemptions_total": "Recompute preemptions under page pressure",
+    "queue_depth": "Requests waiting for a slot",
+    "active_requests": "Requests currently decoding",
+    "kv_pages_free": "Free KV-cache pages",
+    "kv_pages_total": "Total usable KV-cache pages",
+    "ttft_p50": "p50 time-to-first-token (seconds)",
+    "ttft_p95": "p95 time-to-first-token (seconds)",
+    "tokens_per_sec": "Decode throughput over the last window",
+    "uptime_seconds": "Server uptime",
+}
+
+COUNTERS = {"requests_total", "requests_finished", "tokens_generated_total",
+            "preemptions_total"}
+
+
+class ThroughputWindow:
+    """Sliding-window tokens/sec estimate, host-side, O(1) amortized."""
+
+    def __init__(self, window_s: float = 10.0):
+        from collections import deque
+        self.window_s = window_s
+        self._events = deque()  # (t, ntokens)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    def record(self, ntokens: int) -> None:
+        now = time.monotonic()
+        self._events.append((now, ntokens))
+        self._prune(now)
+
+    def rate(self) -> float:
+        now = time.monotonic()
+        self._prune(now)
+        if not self._events:
+            return 0.0
+        span = max(now - self._events[0][0], 1e-6)
+        return sum(n for _, n in self._events) / span
+
+
+def render_prometheus(values: Dict[str, float]) -> str:
+    """Dict -> prometheus exposition text."""
+    lines = []
+    for name, val in sorted(values.items()):
+        full = f"{PREFIX}_{name}"
+        if name in HELP:
+            lines.append(f"# HELP {full} {HELP[name]}")
+            kind = "counter" if name in COUNTERS else "gauge"
+            lines.append(f"# TYPE {full} {kind}")
+        lines.append(f"{full} {float(val):g}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,268 @@
+"""Continuous-batching scheduler: admission, decode interleave, preemption.
+
+Realizes the reference's planned "Scheduling System" layer
+(/root/reference/CLAUDE.md:22 — "Workload distribution and synchronization
+across compute nodes"; no implementation exists, SURVEY.md §0) for the
+BASELINE.json configs[4] serving shape.
+
+Host-side policy over the static-shape device programs in
+engine/serving.py:
+
+* tick() = [admit waiting requests into free slots + prefill each] then
+  [one batched decode step for all active slots].
+* Admission allocates pages for prompt+1; each decode step grows a slot's
+  pages just-in-time. If the pool is exhausted, the youngest running
+  request is PREEMPTED (pages freed, request requeued; its prompt +
+  generated-so-far become the new prompt and are recomputed on
+  readmission — vLLM-style recompute preemption).
+* Per-request sampling: temperature is a per-slot device array;
+  stop-token/max-tokens checks are host-side (the host sees every token
+  anyway when streaming).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from butterfly_tpu.cache.allocator import PageAllocator
+from butterfly_tpu.engine.serving import ServingEngine, sample_batched
+
+
+@dataclass
+class Request:
+    id: int
+    prompt: List[int]
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    stop_token: int = -1
+    # runtime state
+    output: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    state: str = "waiting"  # waiting | running | finished | cancelled
+    preemptions: int = 0
+    t_arrive: float = field(default_factory=time.monotonic)
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    on_token: Optional[Callable[["Request", int], None]] = None
+    on_finish: Optional[Callable[["Request"], None]] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in ("finished", "cancelled")
+
+    @property
+    def all_tokens(self) -> List[int]:
+        """Prompt + generated-so-far: what a (re)prefill must cover."""
+        return self.prompt + self.output
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_arrive
+
+
+class Scheduler:
+    """Continuous batching over a ServingEngine."""
+
+    def __init__(self, engine: ServingEngine, seed: int = 0):
+        self.engine = engine
+        rt = engine.runtime
+        max_pages = engine.cache.page_table.shape[1]
+        self.alloc = PageAllocator(engine.cache.num_pages - 1,
+                                   engine.cache.page_size, max_pages)
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []
+        self.slots: List[Optional[Request]] = [None] * engine.num_slots
+        self._ids = itertools.count()
+        self._key = jax.random.PRNGKey(seed)
+        self._next_tokens = np.zeros((engine.num_slots,), np.int32)
+        self._metrics: Dict[str, float] = {
+            "requests_total": 0, "requests_finished": 0,
+            "tokens_generated_total": 0, "preemptions_total": 0,
+        }
+        self._ttfts: List[float] = []
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 128,
+               temperature: float = 0.0, stop_token: int = -1,
+               on_token=None, on_finish=None) -> Request:
+        # Reject what can never fit: a request that exceeds the per-seq
+        # page limit or the whole pool would self-preempt forever.
+        worst = -(-(len(prompt) + max_new_tokens) // self.alloc.page_size)
+        if worst > self.alloc.max_pages_per_seq or worst > self.alloc.num_pages:
+            raise ValueError(
+                f"request needs {worst} KV pages (prompt {len(prompt)} + "
+                f"max_new {max_new_tokens}) but the limit is "
+                f"{min(self.alloc.max_pages_per_seq, self.alloc.num_pages)}")
+        req = Request(id=next(self._ids), prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, temperature=temperature,
+                      stop_token=stop_token, on_token=on_token,
+                      on_finish=on_finish)
+        self.waiting.append(req)
+        self._metrics["requests_total"] += 1
+        return req
+
+    def cancel(self, req: Request) -> None:
+        """Abort a request (e.g. client disconnect): frees slot + pages."""
+        if req.done:
+            return
+        if req in self.waiting:
+            self.waiting.remove(req)
+        self._finish(req, state="cancelled")
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def run_until_done(self, max_ticks: int = 100000) -> None:
+        for _ in range(max_ticks):
+            if not self.has_work:
+                return
+            self.tick()
+        raise RuntimeError("scheduler did not drain")
+
+    def tick(self) -> int:
+        """One scheduling round: admit, then one decode step.
+
+        Returns the number of tokens generated this round (throughput
+        accounting for the serve loop)."""
+        before = self._metrics["tokens_generated_total"]
+        self._admit()
+        if self.running:
+            self._decode_step()
+        return int(self._metrics["tokens_generated_total"] - before)
+
+    def metrics(self) -> Dict[str, float]:
+        m = dict(self._metrics)
+        m["queue_depth"] = len(self.waiting)
+        m["active_requests"] = len(self.running)
+        m["kv_pages_free"] = self.alloc.free_pages
+        m["kv_pages_total"] = self.alloc.num_pages
+        if self._ttfts:
+            a = np.asarray(self._ttfts)
+            m["ttft_p50"] = float(np.percentile(a, 50))
+            m["ttft_p95"] = float(np.percentile(a, 95))
+        return m
+
+    # -- internals ----------------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self) -> None:
+        while self.waiting:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            req = self.waiting[0]
+            prefix = req.all_tokens  # includes output if preempted earlier
+            if self.alloc.grow(slot, len(prefix) + 1) is None:
+                return  # pool exhausted; decode will free/preempt
+            self.waiting.popleft()
+            req.slot, req.state = slot, "running"
+            self.slots[slot] = req
+            self.running.append(req)
+
+            self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+            logits = self.engine.prefill_slot(slot, prefix)
+            self._key, sub = jax.random.split(self._key)
+            first = sample_batched(
+                logits[None], sub,
+                np.asarray([req.temperature], np.float32),
+                self.engine.runtime_top_k, self.engine.runtime_top_p)
+            self._emit(req, int(first[0]))
+            self._next_tokens[slot] = int(first[0])
+
+    def _decode_step(self) -> None:
+        # just-in-time page growth (may preempt the youngest requests)
+        for req in list(self.running):
+            if req in self.running:  # may have been preempted as a victim
+                self._ensure_or_preempt(req, len(req.all_tokens) + 1)
+        if not self.running:
+            return
+
+        active = np.zeros((self.engine.num_slots,), bool)
+        temps = np.zeros((self.engine.num_slots,), np.float32)
+        for req in self.running:
+            active[req.slot] = True
+            temps[req.slot] = req.temperature
+        self._key, sub = jax.random.split(self._key)
+        nxt, _ = self.engine.decode_active(self._next_tokens, active, temps,
+                                           sub)
+        for req in list(self.running):
+            slot = req.slot  # _emit clears it when the request finishes
+            self._next_tokens[slot] = int(nxt[slot])
+            self._emit(req, int(nxt[slot]))
+
+    def _emit(self, req: Request, token: int) -> None:
+        """Record one generated token; finish/stop bookkeeping."""
+        now = time.monotonic()
+        if req.t_first_token is None:
+            req.t_first_token = now
+            self._ttfts.append(req.ttft)
+        req.output.append(token)
+        self._metrics["tokens_generated_total"] += 1
+        if req.on_token is not None:
+            req.on_token(req, token)
+        hit_stop = req.stop_token >= 0 and token == req.stop_token
+        if hit_stop or len(req.output) >= req.max_new_tokens:
+            self._finish(req)
+
+    def _finish(self, req: Request, state: str = "finished") -> None:
+        req.state = state
+        req.t_finish = time.monotonic()
+        if req.slot is not None:
+            self.alloc.release(req.slot)
+            self.engine.reset_slot(req.slot)
+            self.slots[req.slot] = None
+            req.slot = None
+        if req in self.running:
+            self.running.remove(req)
+        if state == "finished":
+            self._metrics["requests_finished"] += 1
+        if req.on_finish is not None:
+            req.on_finish(req)
+
+    def _ensure_or_preempt(self, req: Request, need_len: int) -> None:
+        """Grow req's pages; preempt youngest runners until it fits."""
+        while True:
+            fresh = self.alloc.grow(req.slot, need_len)
+            if fresh is not None:
+                if fresh:  # push the grown block table to the device
+                    self.engine.set_table_row(req.slot,
+                                              self.alloc.pages_of(req.slot))
+                return
+            victim = self._youngest_other(req)
+            if victim is None:
+                # nothing left to evict: preempt req itself
+                self._preempt(req)
+                return
+            self._preempt(victim)
+
+    def _youngest_other(self, req: Request) -> Optional[Request]:
+        cands = [r for r in self.running if r is not req]
+        return max(cands, key=lambda r: r.t_arrive) if cands else None
+
+    def _preempt(self, req: Request) -> None:
+        """Recompute-style preemption: free pages, requeue at the front."""
+        self._metrics["preemptions_total"] += 1
+        req.preemptions += 1
+        self.alloc.release(req.slot)
+        self.engine.reset_slot(req.slot)
+        self.slots[req.slot] = None
+        req.slot = None
+        self.running.remove(req)
+        # all_tokens (prompt + output) are recomputed on readmission
+        req.state = "waiting"
+        self.waiting.appendleft(req)

@@ -1,10 +1,237 @@
-"""HTTP serving endpoint — implemented with the continuous-batching
-scheduler in slice 4 (SURVEY.md §7 build order step 4)."""
+"""HTTP serving: the reference's planned client-facing API layer
+(/root/reference/CLAUDE.md:23) over the continuous-batching scheduler.
+
+stdlib-only (ThreadingHTTPServer — no web framework dependencies, per the
+zero-egress environment):
+
+* POST /generate  {"prompt": str | "tokens": [int], "max_tokens",
+                   "temperature", "stop_token", "stream": bool}
+  -> {"text", "tokens", "ttft_s", "total_s"}; with "stream": true the
+  response is SSE (`data: {"token": id, "text": piece}` per token,
+  terminated by `data: [DONE]`).
+* GET /metrics    Prometheus text (obs/metrics.py)
+* GET /health     {"status": "ok"}
+
+One scheduler thread owns all device work (ticks); HTTP handler threads
+only enqueue requests and wait on per-request queues — JAX never runs on
+more than one host thread.
+"""
 from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from butterfly_tpu.obs.metrics import ThroughputWindow, render_prometheus
+
+
+class ServerState:
+    def __init__(self, scheduler, tokenizer, max_queue: int = 256):
+        self.sched = scheduler
+        self.tok = tokenizer
+        self.lock = threading.Lock()       # guards scheduler state
+        self.wake = threading.Event()      # new work signal
+        self.stop = threading.Event()
+        self.max_queue = max_queue
+        self.throughput = ThroughputWindow()
+        self.t_start = time.monotonic()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+
+    # -- scheduler thread ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self.stop.is_set():
+            with self.lock:
+                has_work = self.sched.has_work
+                made = self.sched.tick() if has_work else 0
+            if has_work:
+                if made:
+                    self.throughput.record(made)
+            else:
+                self.wake.wait(timeout=0.05)
+                self.wake.clear()
+
+    # -- handler-thread API ---------------------------------------------------
+
+    def submit(self, tokens, max_tokens, temperature, stop_token):
+        q: queue.Queue = queue.Queue()
+
+        def on_token(req, token):
+            q.put(token)
+
+        def on_finish(req):
+            q.put(None)  # completion sentinel (after the last on_token)
+
+        with self.lock:
+            if len(self.sched.waiting) >= self.max_queue:
+                return None, None
+            req = self.sched.submit(tokens, max_new_tokens=max_tokens,
+                                    temperature=temperature,
+                                    stop_token=stop_token,
+                                    on_token=on_token, on_finish=on_finish)
+        self.wake.set()
+        return req, q
+
+    def metrics_text(self) -> str:
+        with self.lock:
+            vals = self.sched.metrics()
+        vals["tokens_per_sec"] = self.throughput.rate()
+        vals["uptime_seconds"] = time.monotonic() - self.t_start
+        return render_prometheus(vals)
+
+
+def make_handler(state: ServerState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._json(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                body = state.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                if "tokens" in body:
+                    tokens = [int(t) for t in body["tokens"]]
+                else:
+                    tokens = state.tok.encode(str(body.get("prompt", "")))
+                vocab = state.sched.engine.cfg.vocab_size
+                if any(t >= vocab or t < 0 for t in tokens):
+                    raise ValueError("token id out of range")
+                if not tokens:
+                    raise ValueError("empty prompt")
+                max_seq = state.sched.engine.cache.max_seq
+                max_tokens = int(body.get("max_tokens", 64))
+                if len(tokens) + max_tokens > max_seq:
+                    raise ValueError(
+                        f"prompt+max_tokens exceeds max_seq {max_seq}")
+                temperature = float(body.get("temperature", 0.0))
+                stop = int(body.get("stop_token",
+                                    -1 if state.tok.eos_id is None
+                                    else state.tok.eos_id))
+            except (ValueError, TypeError, KeyError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            t0 = time.monotonic()
+
+            try:
+                req, q = state.submit(tokens, max_tokens, temperature, stop)
+            except ValueError as e:  # can never fit the page pool
+                self._json(400, {"error": str(e)})
+                return
+            if req is None:
+                self._json(429, {"error": "queue full"})
+                return
+
+            if body.get("stream"):
+                self._stream(req, q, t0)
+            else:
+                toks = []
+                while True:
+                    tok = q.get()
+                    if tok is None:
+                        break
+                    toks.append(tok)
+                self._json(200, {
+                    "tokens": toks,
+                    "text": state.tok.decode(toks),
+                    "ttft_s": req.ttft,
+                    "total_s": time.monotonic() - t0,
+                })
+
+        def _stream(self, req, q, t0) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):X}\r\n".encode() + data
+                                 + b"\r\n")
+
+            try:
+                while True:
+                    tok = q.get()
+                    if tok is None:
+                        break
+                    piece = state.tok.decode([tok])
+                    msg = json.dumps({"token": tok, "text": piece})
+                    chunk(f"data: {msg}\n\n".encode())
+                chunk(b"data: [DONE]\n\n")
+                chunk(b"")  # terminating chunk
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away: stop generating for a dead socket
+                with state.lock:
+                    state.sched.cancel(req)
+
+    return Handler
+
+
+def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
+                  port: int = 8000, max_queue: int = 256,
+                  ready_event: Optional[threading.Event] = None):
+    """Blocking serve loop. `ready_event` is set once listening (tests)."""
+    state = ServerState(scheduler, tokenizer, max_queue)
+    state.thread.start()
+    httpd = ThreadingHTTPServer((host, port), make_handler(state))
+    state.httpd = httpd
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        httpd.serve_forever()
+    finally:
+        state.stop.set()
+        httpd.server_close()
+    return 0
 
 
 def run_server(args) -> int:
-    raise NotImplementedError(
-        "`butterfly serve` requires the continuous-batching scheduler "
-        "(butterfly_tpu.sched), which lands in the next build slice. "
-        "Use `butterfly generate` for one-shot inference meanwhile.")
+    """`butterfly serve` entrypoint (serve/cli.py)."""
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+    from butterfly_tpu.serve.cli import load_params, resolve_model
+    from butterfly_tpu.utils.tokenizer import load_tokenizer
+
+    model = resolve_model(args)
+    tok = load_tokenizer(args.tokenizer or args.ckpt)
+    params = load_params(model, args)
+    rt = RuntimeConfig(max_batch_size=args.max_batch,
+                       max_seq_len=args.max_seq, page_size=args.page_size)
+    engine = ServingEngine(model, params, rt)
+    sched = Scheduler(engine)
+    print(f"[butterfly] serving {args.model} on {args.host}:{args.port} "
+          f"(slots={rt.max_batch_size}, pages={engine.cache.num_pages - 1}"
+          f"x{rt.page_size}tok)", flush=True)
+    return serve_forever(sched, tok, args.host, args.port)

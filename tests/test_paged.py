@@ -1,0 +1,116 @@
+"""Paged KV cache tests: parity with the contiguous cache + allocator
+bookkeeping (SURVEY.md §7 stage 4; BASELINE.json configs[4])."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from butterfly_tpu.cache.allocator import PageAllocator
+from butterfly_tpu.cache.paged import (
+    PagedKVCache, gather_paged_layer, init_paged_cache, paged_forward,
+    write_paged_layer)
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.models.common import Model, forward, init_cache
+
+
+CFG = tiny("llama", dtype="float32", param_dtype="float32")
+RT = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+
+
+def seq_table(cache, batch, pages_per_seq):
+    """Identity block tables: slot b owns pages [b*p .. (b+1)*p)."""
+    table = np.full(np.asarray(cache.page_table).shape, cache.null_page,
+                    np.int32)
+    for b in range(batch):
+        table[b, :pages_per_seq] = np.arange(
+            b * pages_per_seq, (b + 1) * pages_per_seq)
+    return cache._replace(page_table=jnp.asarray(table))
+
+
+def test_paged_forward_matches_contiguous():
+    """Prefill + 4 decode steps: logits equal the contiguous-cache path."""
+    params = Model(CFG).init(jax.random.PRNGKey(0))
+    cache_c = init_cache(CFG, batch=2, max_seq=64)
+    cache_p = seq_table(init_paged_cache(CFG, RT), 2, 64 // RT.page_size)
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, CFG.vocab_size, (2, 9)))
+    ref, cache_c = jax.jit(lambda p, t, c: forward(p, CFG, t, c))(
+        params, tokens, cache_c)
+    out, cache_p = jax.jit(lambda p, t, c: paged_forward(p, CFG, t, c))(
+        params, tokens, cache_p)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    for step in range(4):
+        nxt = jnp.argmax(ref[:, -1, :], axis=-1)[:, None]
+        ref, cache_c = jax.jit(lambda p, t, c: forward(p, CFG, t, c))(
+            params, nxt, cache_c)
+        out, cache_p = jax.jit(
+            lambda p, t, c: paged_forward(p, CFG, t, c))(params, nxt, cache_p)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_inactive_slots_frozen():
+    """active=False slots keep their length and never corrupt others."""
+    params = Model(CFG).init(jax.random.PRNGKey(0))
+    cache = seq_table(init_paged_cache(CFG, RT), 2, 8)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 5)))
+    _, cache = paged_forward(params, CFG, tokens, cache)
+
+    active = jnp.asarray([True, False])
+    tok = jnp.asarray([[7], [9]])
+    out_a, cache2 = paged_forward(params, CFG, tok, cache, active=active)
+    assert int(cache2.lengths[0]) == 6 and int(cache2.lengths[1]) == 5
+
+    # slot 1's pages are untouched by slot 0's step
+    p1 = np.asarray(cache.page_table)[1, :1]
+    np.testing.assert_array_equal(np.asarray(cache2.k_pages[:, p1]),
+                                  np.asarray(cache.k_pages[:, p1]))
+
+
+def test_write_gather_roundtrip():
+    k_pages = jnp.zeros((6, 4, 2, 3))
+    v_pages = jnp.zeros((6, 4, 2, 3))
+    table = jnp.asarray([[0, 2], [3, 1]], jnp.int32)  # interleaved pages
+    k = jnp.arange(2 * 5 * 2 * 3, dtype=jnp.float32).reshape(2, 5, 2, 3)
+    start = jnp.asarray([0, 3], jnp.int32)
+    # slot1 writing at start=3 spills onto its second page (page id 1)
+    kp, vp = write_paged_layer(k_pages, v_pages, table, k, k * 2, start)
+    got = gather_paged_layer(kp, table)
+    np.testing.assert_allclose(np.asarray(got[0, 0:5]), np.asarray(k[0]))
+    np.testing.assert_allclose(np.asarray(got[1, 3:8]), np.asarray(k[1]))
+
+
+def test_allocator_grow_release():
+    a = PageAllocator(num_pages=10, page_size=4, max_pages_per_seq=4)
+    assert a.grow(0, 9) is not None       # 3 pages
+    assert a.free_pages == 7
+    assert a.grow(0, 12) == []            # fits in current pages
+    assert a.pages_needed(0, 13) == 1
+    assert a.grow(1, 16) is not None      # 4 pages
+    assert a.free_pages == 3
+    assert a.grow(0, 16) is not None      # 1 more page
+    assert a.free_pages == 2
+    assert a.grow(0, 17) is None          # over max_pages_per_seq
+    assert a.grow(2, 9) is None           # needs 3 > 2 free, all-or-nothing
+    assert a.free_pages == 2
+    assert a.release(1) and a.free_pages == 6
+    a.release(0)
+    assert a.free_pages == 10
+
+
+@pytest.mark.parametrize("lengths", [[1, 17, 8], [32, 1, 5]])
+def test_allocator_property_accounting(lengths):
+    """Σ owned + free == total, and no page owned twice."""
+    a = PageAllocator(num_pages=32, page_size=4, max_pages_per_seq=16)
+    for slot, ln in enumerate(lengths):
+        assert a.grow(slot, ln) is not None
+    owned = [p for s in range(len(lengths)) for p in a.pages_of(s)]
+    assert len(owned) == len(set(owned))
+    assert len(owned) + a.free_pages == 32
+    for s in range(len(lengths)):
+        a.release(s)
+    assert a.free_pages == 32
