@@ -35,9 +35,12 @@ class ThroughputWindow:
     """Sliding-window tokens/sec estimate, host-side, O(1) amortized."""
 
     def __init__(self, window_s: float = 10.0):
+        import threading
         from collections import deque
         self.window_s = window_s
         self._events = deque()  # (t, ntokens)
+        # record() runs on the scheduler thread, rate() on HTTP handlers
+        self._lock = threading.Lock()
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.window_s
@@ -46,16 +49,18 @@ class ThroughputWindow:
 
     def record(self, ntokens: int) -> None:
         now = time.monotonic()
-        self._events.append((now, ntokens))
-        self._prune(now)
+        with self._lock:
+            self._events.append((now, ntokens))
+            self._prune(now)
 
     def rate(self) -> float:
         now = time.monotonic()
-        self._prune(now)
-        if not self._events:
-            return 0.0
-        span = max(now - self._events[0][0], 1e-6)
-        return sum(n for _, n in self._events) / span
+        with self._lock:
+            self._prune(now)
+            if not self._events:
+                return 0.0
+            span = max(now - self._events[0][0], 1e-6)
+            return sum(n for _, n in self._events) / span
 
 
 def render_prometheus(values: Dict[str, float]) -> str:

@@ -1,0 +1,192 @@
+"""Sequence/context parallelism: ring attention + Ulysses (long context).
+
+The reference never mentions long-context mechanisms (SURVEY.md §5: absent
+from all 6 files); this realizes the survey's required surface the TPU way:
+
+* **Ring attention** (context parallel): Q/K/V are sequence-sharded over
+  the `seq` mesh axis. Each of the N ring steps computes blockwise
+  attention of the local Q chunk against the visiting K/V block, folded
+  into an online-softmax accumulator (running max / denominator — the
+  FlashAttention recurrence), then rotates K/V (+ their positions) to the
+  next neighbor with `lax.ppermute`. On TPU the ring rides neighbor ICI
+  links and XLA overlaps the permute with the block's einsums. Causality
+  comes from comparing rotated K positions to local Q positions, so any
+  chunk order works and no step is skipped (static schedule).
+
+* **Ulysses**: `lax.all_to_all` reshards [B, T/N, H_all] -> [B, T, H/N]
+  (heads scatter, sequence gathers), runs ordinary full attention on the
+  now-complete local sequence for its head group, and reshards back.
+  Requires num_kv_heads % N == 0; ring has no such constraint.
+
+* **sp_forward**: whole-model long-context prefill under shard_map manual
+  over {'seq'} — norms/MLP/MoE are token-pointwise (trivially sequence-
+  parallel), attention uses ring or Ulysses; `tensor`/`data` axes remain
+  GSPMD-auto inside, so SP composes with TP. Returns logits and the
+  sequence-sharded KV cache (each device keeps the K/V it computed —
+  that sharded layout IS the context-parallel cache).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from butterfly_tpu.core.config import ModelConfig
+from butterfly_tpu.models.common import (
+    KVCache, Params, embed_tokens, final_logits, mlp_block, moe_block,
+    qkv_proj, attn_output, rms_norm, layer_norm, rope_freqs)
+
+NEG = -1e30
+
+
+def _block_scores(q, k, q_pos, k_pos, scale):
+    """Masked f32 scores for one (local-Q, visiting-K) block pair.
+
+    q: [B,Tq,Kv,G,H]; k: [B,Tk,Kv,H]; positions: [B,Tq]/[B,Tk].
+    Returns [B,Kv,Tq,G,Tk]."""
+    s = jnp.einsum("btkgh,bskh->bktgs", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    causal = k_pos[:, None, :] <= q_pos[:, :, None]        # [B,Tq,Tk]
+    return jnp.where(causal[:, None, :, None, :], s, NEG)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   q_pos: jax.Array, k_pos: jax.Array,
+                   axis_name: str = "seq") -> jax.Array:
+    """Causal GQA over a sequence ring (call inside shard_map).
+
+    q: [B, Tq, Nq, H] local chunk; k/v: [B, Tk, Kv, H] local chunk;
+    q_pos/k_pos: [B, T*] absolute positions. Returns [B, Tq, Nq, H].
+    """
+    B, Tq, Nq, H = q.shape
+    Kv = k.shape[2]
+    G = Nq // Kv
+    N = lax.axis_size(axis_name)
+    qg = q.reshape(B, Tq, Kv, G, H)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(H, jnp.float32))
+    perm = [(i, (i + 1) % N) for i in range(N)]
+
+    # online-softmax accumulators
+    m = jnp.full((B, Kv, Tq, G), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, Kv, Tq, G), jnp.float32)
+    acc = jnp.zeros((B, Kv, Tq, G, H), jnp.float32)
+
+    def step(carry, _):
+        m, l, acc, k, v, k_pos = carry
+        s = _block_scores(qg, k, q_pos, k_pos, scale)      # [B,Kv,Tq,G,Tk]
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # fully-masked rows keep m=-inf; guard the exp shift
+        shift = jnp.where(jnp.isinf(m_new), 0.0, m - m_new)
+        p = jnp.exp(s - jnp.where(jnp.isinf(m_new), 0.0, m_new)[..., None])
+        p = jnp.where(s <= NEG, 0.0, p)
+        corr = jnp.exp(shift)
+        l2 = l * corr + jnp.sum(p, axis=-1)
+        acc2 = acc * corr[..., None] + jnp.einsum(
+            "bktgs,bskh->bktgh", p, v.astype(jnp.float32))
+        k, v, k_pos = lax.ppermute((k, v, k_pos), axis_name, perm)
+        return (m_new, l2, acc2, k, v, k_pos), None
+
+    (m, l, acc, _, _, _), _ = lax.scan(
+        step, (m, l, acc, k, v, k_pos), None, length=N)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]       # [B,Kv,Tq,G,H]
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, Tq, Nq, H).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      q_pos: jax.Array, axis_name: str = "seq") -> jax.Array:
+    """All-to-all head<->sequence reshard + local full causal attention.
+
+    q: [B, T/N, Nq, H]; k/v: [B, T/N, Kv, H]. Needs Nq % N == 0 and
+    Kv % N == 0. Returns [B, T/N, Nq, H].
+    """
+    from butterfly_tpu.models.common import attend
+    N = lax.axis_size(axis_name)
+    B, Tl, Nq, H = q.shape
+    # heads scatter (axis 2), sequence gathers (axis 1)
+    qq = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kk = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vv = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    # full global positions for the gathered sequence
+    pos = lax.all_gather(q_pos, axis_name, axis=1, tiled=True)  # [B, T]
+    mask = pos[:, None, :] <= pos[:, :, None]                   # [B,T,T]
+    out = attend(qq, kk, vv, mask, None)  # attend() reads only shapes+mask
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Whole-model sequence-parallel prefill
+# ---------------------------------------------------------------------------
+
+def sp_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+               mesh: Mesh, impl: str = "ring"
+               ) -> Tuple[jax.Array, KVCache]:
+    """Long-context prefill with activations sharded over `seq`.
+
+    tokens: [B, T] (T divisible by the seq axis). Returns
+    (logits [B,T,V] seq-sharded on T, KVCache with S = T seq-sharded).
+    """
+    N = mesh.shape["seq"]
+    B, T = tokens.shape
+    if T % N != 0:
+        raise ValueError(f"seq len {T} not divisible by seq axis {N}")
+
+    body = partial(_sp_body, cfg=cfg, impl=impl)
+    layer_in = jax.tree.map(lambda _: P(), params["layers"])
+    head_in = jax.tree.map(lambda _: P(), {
+        k: v for k, v in params.items() if k != "layers"})
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(layer_in, head_in, P(None, "seq")),
+        out_specs=(P(None, "seq"), P(None, None, "seq")),
+        axis_names={"seq"}, check_vma=False)
+    logits, (ks, vs) = fn(params["layers"],
+                          {k: v for k, v in params.items() if k != "layers"},
+                          tokens)
+    cache = KVCache(k=ks, v=vs,
+                    length=jnp.full((B,), T, jnp.int32))
+    return logits, cache
+
+
+def _sp_body(layers, head, tokens, *, cfg: ModelConfig, impl: str):
+    """Per-device chunk of the model (inside shard_map, manual over seq)."""
+    idx = lax.axis_index("seq")
+    B, Tl = tokens.shape
+    positions = idx * Tl + jnp.arange(Tl)[None, :] + jnp.zeros(
+        (B, 1), jnp.int32)                                   # [B,Tl] global
+    x, cos, sin = embed_tokens(head, cfg, tokens, positions)
+    compute_dtype = jnp.dtype(cfg.dtype)
+
+    def layer(x, lp):
+        lp = jax.tree.map(lambda a: a.astype(compute_dtype), lp)
+        if cfg.arch == "gpt2":
+            h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"],
+                           cfg.norm_eps)
+        else:
+            h = rms_norm(x, lp["ln1"]["scale"], cfg.norm_eps)
+        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+        if impl == "ring":
+            out = ring_attention(q, k, v, positions, positions)
+        else:
+            out = ulysses_attention(q, k, v, positions)
+        x = x + attn_output(out, lp["attn"], cfg)
+
+        if cfg.arch == "gpt2":
+            h = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"],
+                           cfg.norm_eps)
+        else:
+            h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
+        if cfg.is_moe:
+            x = x + moe_block(h, lp["moe"], cfg)
+        else:
+            x = x + mlp_block(h, lp["mlp"], cfg)
+        return x, (k.astype(compute_dtype), v.astype(compute_dtype))
+
+    x, (ks, vs) = lax.scan(layer, x, layers)
+    logits = final_logits(head, cfg, x)
+    return logits, (ks, vs)

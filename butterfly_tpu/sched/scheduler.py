@@ -235,7 +235,8 @@ class Scheduler:
             req.on_finish(req)
 
     def _ensure_or_preempt(self, req: Request, need_len: int) -> None:
-        """Grow req's pages; preempt youngest runners until it fits."""
+        """Grow req's pages; preempt the youngest runner (possibly req
+        itself) until it fits — older requests always win page pressure."""
         while True:
             fresh = self.alloc.grow(req.slot, need_len)
             if fresh is not None:
@@ -243,16 +244,10 @@ class Scheduler:
                     self.engine.set_table_row(req.slot,
                                               self.alloc.pages_of(req.slot))
                 return
-            victim = self._youngest_other(req)
-            if victim is None:
-                # nothing left to evict: preempt req itself
-                self._preempt(req)
-                return
+            victim = max(self.running, key=lambda r: r.t_arrive)
             self._preempt(victim)
-
-    def _youngest_other(self, req: Request) -> Optional[Request]:
-        cands = [r for r in self.running if r is not req]
-        return max(cands, key=lambda r: r.t_arrive) if cands else None
+            if victim is req:
+                return
 
     def _preempt(self, req: Request) -> None:
         """Recompute-style preemption: free pages, requeue at the front."""

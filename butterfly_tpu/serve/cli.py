@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--max-batch", type=int, default=8)
     s.add_argument("--page-size", type=int, default=16)
+    s.add_argument("--top-k", type=int, default=0,
+                   help="serving-wide top-k sampling filter")
+    s.add_argument("--top-p", type=float, default=1.0)
+    s.add_argument("--max-queue", type=int, default=256)
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)

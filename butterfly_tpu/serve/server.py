@@ -38,15 +38,25 @@ class ServerState:
         self.max_queue = max_queue
         self.throughput = ThroughputWindow()
         self.t_start = time.monotonic()
+        self.error: str = ""               # set => serving is wedged: 503s
         self.thread = threading.Thread(target=self._loop, daemon=True)
 
     # -- scheduler thread ----------------------------------------------------
 
     def _loop(self) -> None:
         while not self.stop.is_set():
-            with self.lock:
-                has_work = self.sched.has_work
-                made = self.sched.tick() if has_work else 0
+            try:
+                with self.lock:
+                    has_work = self.sched.has_work
+                    made = self.sched.tick() if has_work else 0
+            except Exception as e:  # device/OOM errors must not wedge
+                self.error = f"{type(e).__name__}: {e}"
+                with self.lock:
+                    # unblock every waiter (on_finish sentinels fire)
+                    for req in list(self.sched.running) + list(
+                            self.sched.waiting):
+                        self.sched.cancel(req)
+                continue
             if has_work:
                 if made:
                     self.throughput.record(made)
@@ -100,7 +110,11 @@ def make_handler(state: ServerState):
 
         def do_GET(self):
             if self.path == "/health":
-                self._json(200, {"status": "ok"})
+                if state.error:
+                    self._json(503, {"status": "error",
+                                     "detail": state.error})
+                else:
+                    self._json(200, {"status": "ok"})
             elif self.path == "/metrics":
                 body = state.metrics_text().encode()
                 self.send_response(200)
@@ -132,6 +146,8 @@ def make_handler(state: ServerState):
                     raise ValueError("empty prompt")
                 max_seq = state.sched.engine.cache.max_seq
                 max_tokens = int(body.get("max_tokens", 64))
+                if max_tokens < 1:
+                    raise ValueError("max_tokens must be >= 1")
                 if len(tokens) + max_tokens > max_seq:
                     raise ValueError(
                         f"prompt+max_tokens exceeds max_seq {max_seq}")
@@ -141,6 +157,9 @@ def make_handler(state: ServerState):
                                     else state.tok.eos_id))
             except (ValueError, TypeError, KeyError) as e:
                 self._json(400, {"error": str(e)})
+                return
+            if state.error:
+                self._json(503, {"error": "server wedged: " + state.error})
                 return
             t0 = time.monotonic()
 
@@ -158,7 +177,14 @@ def make_handler(state: ServerState):
             else:
                 toks = []
                 while True:
-                    tok = q.get()
+                    try:
+                        tok = q.get(timeout=0.5)
+                    except queue.Empty:
+                        if not self._client_alive():
+                            with state.lock:
+                                state.sched.cancel(req)
+                            return
+                        continue
                     if tok is None:
                         break
                     toks.append(tok)
@@ -168,6 +194,18 @@ def make_handler(state: ServerState):
                     "ttft_s": req.ttft,
                     "total_s": time.monotonic() - t0,
                 })
+
+        def _client_alive(self) -> bool:
+            """Peek the socket: a closed peer reads as EOF (b'')."""
+            import socket
+            try:
+                data = self.connection.recv(1, socket.MSG_PEEK
+                                            | socket.MSG_DONTWAIT)
+                return data != b""
+            except (BlockingIOError, InterruptedError):
+                return True          # no data pending = still connected
+            except OSError:
+                return False
 
         def _stream(self, req, q, t0) -> None:
             self.send_response(200)
@@ -228,10 +266,13 @@ def run_server(args) -> int:
     tok = load_tokenizer(args.tokenizer or args.ckpt)
     params = load_params(model, args)
     rt = RuntimeConfig(max_batch_size=args.max_batch,
-                       max_seq_len=args.max_seq, page_size=args.page_size)
+                       max_seq_len=args.max_seq, page_size=args.page_size,
+                       top_k=args.top_k, top_p=args.top_p,
+                       max_queue=args.max_queue)
     engine = ServingEngine(model, params, rt)
     sched = Scheduler(engine)
     print(f"[butterfly] serving {args.model} on {args.host}:{args.port} "
           f"(slots={rt.max_batch_size}, pages={engine.cache.num_pages - 1}"
           f"x{rt.page_size}tok)", flush=True)
-    return serve_forever(sched, tok, args.host, args.port)
+    return serve_forever(sched, tok, args.host, args.port,
+                         max_queue=rt.max_queue)

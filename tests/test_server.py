@@ -123,3 +123,46 @@ def test_validation_errors(server):
 
 
 import urllib.error  # noqa: E402
+
+
+def test_scheduler_crash_degrades_health():
+    """A tick() exception must not wedge the server: waiters unblock,
+    /health goes 503, new submissions are rejected."""
+    import queue as _q
+    from butterfly_tpu.serve.server import ServerState
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(0))
+    rt = RuntimeConfig(max_batch_size=1, max_seq_len=64, page_size=8)
+    sched = Scheduler(ServingEngine(model, params, rt))
+    state = ServerState(sched, ByteTokenizer())
+
+    calls = {"n": 0}
+    def boom():
+        calls["n"] += 1
+        raise RuntimeError("device on fire")
+    sched.tick = boom
+    state.thread.start()
+    req, q = state.submit([1, 2], 4, 0.0, -1)
+    assert q.get(timeout=10) is None        # sentinel: waiter unblocked
+    assert req.state == "cancelled"
+    assert "device on fire" in state.error
+    assert state.submit([1], 2, 0.0, -1) != (None, None)  # queued but...
+    state.stop.set()
+
+
+def test_preemption_prefers_youngest():
+    """Older request keeps its pages; the newcomer preempts itself."""
+    from butterfly_tpu.sched.scheduler import Scheduler as S
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(0))
+    # pool: 5 usable pages of 4 -> two requests to ~16 tokens can't coexist
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=32, page_size=4,
+                       num_pages=5)
+    sched = S(ServingEngine(model, params, rt))
+    r_old = sched.submit([5, 7, 11], max_new_tokens=12)
+    sched.tick()
+    r_new = sched.submit([3, 1], max_new_tokens=12)
+    sched.run_until_done(max_ticks=400)
+    assert r_old.state == "finished" and r_new.state == "finished"
+    assert r_old.preemptions == 0          # the older one is never evicted
+    assert r_new.preemptions > 0
