@@ -1,0 +1,50 @@
+"""Shared harness for the five BASELINE benchmark scripts."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable from anywhere: the package lives at the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(desc: str, **extra):
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--tiny", action="store_true",
+                   help="shrink the model for CI / fake-device runs")
+    p.add_argument("--fake-devices", type=int, default=0,
+                   help="run on N fake CPU devices (mesh-shape validation)")
+    p.add_argument("--batch", type=int, default=extra.pop("batch", 8))
+    p.add_argument("--prompt-len", type=int,
+                   default=extra.pop("prompt_len", 128))
+    p.add_argument("--max-new", type=int, default=extra.pop("max_new", 128))
+    for k, v in extra.items():
+        p.add_argument(f"--{k.replace('_', '-')}", type=type(v), default=v)
+    args = p.parse_args()
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}"
+        ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return args
+
+
+def emit(metric: str, value: float, unit: str, **kw) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, **kw}))
+
+
+def timed(fn, *args, warmup: int = 1, iters: int = 1):
+    for _ in range(warmup):
+        fn(*args)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    import jax
+    jax.block_until_ready(out)
+    return out, (time.perf_counter() - t0) / iters

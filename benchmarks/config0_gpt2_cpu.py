@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""BASELINE config 0: GPT-2 124M single-host greedy decode (CPU reference)."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, parse_args  # noqa: E402
+
+
+def main():
+    args = parse_args("GPT-2 124M greedy decode", batch=4, prompt_len=64,
+                      max_new=64)
+    import jax
+    from butterfly_tpu.core.config import gpt2_124m, tiny
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.obs.benchmark import run_decode_benchmark
+
+    cfg = tiny("gpt2") if args.tiny else gpt2_124m()
+    if jax.default_backend() == "cpu":
+        cfg = cfg.replace(dtype="float32")
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    stats = run_decode_benchmark(model, params, batch=args.batch,
+                                 prompt_len=args.prompt_len,
+                                 max_new=args.max_new)
+    emit("gpt2_decode_tokens_per_sec", stats["tokens_per_sec"],
+         "tokens/sec", config="baseline_config_0",
+         tokens_per_sec_per_chip=round(stats["tokens_per_sec_per_chip"], 2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""BASELINE config 1: Llama-3 8B tensor-parallel TP=8 on one host.
+
+On fake devices this validates the TP mesh/schedule end-to-end (compile +
+run + logit-parity-grade numerics); on a real v5e-8 it measures
+tokens/sec/chip.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, parse_args, timed  # noqa: E402
+
+
+def main():
+    args = parse_args("Llama-3 8B TP=8", tp=8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from butterfly_tpu.core.config import MeshConfig, llama3_8b, tiny
+    from butterfly_tpu.core.mesh import make_mesh
+    from butterfly_tpu.models.common import Model, forward, init_cache
+    from butterfly_tpu.parallel.partition import shard_cache, shard_params
+
+    cfg = tiny("llama", dtype="float32", param_dtype="float32") if args.tiny \
+        else llama3_8b()
+    mesh = make_mesh(MeshConfig(tensor=args.tp),
+                     jax.devices()[:args.tp])
+    model = Model(cfg)
+    params = shard_params(model.init(jax.random.PRNGKey(0)), cfg, mesh)
+    cache = shard_cache(
+        init_cache(cfg, args.batch, args.prompt_len + args.max_new),
+        cfg, mesh)
+    tokens = jax.device_put(
+        jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (args.batch, args.prompt_len))),
+        NamedSharding(mesh, P()))
+
+    def step(params, tokens, cache):
+        return forward(params, cfg, tokens, cache)
+
+    with jax.set_mesh(mesh):
+        jit_step = jax.jit(step)
+        (_, cache), dt_prefill = timed(jit_step, params, tokens, cache)
+        one = tokens[:, :1]
+        (_, cache), dt_decode = timed(jax.jit(step), params, one, cache,
+                                      warmup=2, iters=8)
+
+    toks = args.batch / dt_decode
+    emit("llama8b_tp_decode_tokens_per_sec", toks, "tokens/sec",
+         config="baseline_config_1", tp=args.tp,
+         tokens_per_sec_per_chip=round(toks / args.tp, 2),
+         prefill_s=round(dt_prefill, 4),
+         ttft_s=round(dt_prefill, 4))
+
+
+if __name__ == "__main__":
+    main()

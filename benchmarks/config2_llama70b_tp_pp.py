@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""BASELINE config 2: Llama-3 70B TPxPP across a 64-way mesh.
+
+Mesh is size-parametric (--tp x --pp x data fills the device count); on
+fake devices this validates the GPipe schedule + TP compose at depth.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, parse_args, timed  # noqa: E402
+
+
+def main():
+    args = parse_args("Llama-3 70B TPxPP", tp=4, pp=2, microbatches=4)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from butterfly_tpu.core.config import MeshConfig, llama3_70b, tiny
+    from butterfly_tpu.core.mesh import make_mesh
+    from butterfly_tpu.models.common import Model, init_cache
+    from butterfly_tpu.parallel.partition import shard_cache, shard_params
+    from butterfly_tpu.parallel.pipeline import pipeline_forward
+
+    n = args.tp * args.pp
+    cfg = tiny("llama", num_layers=2 * args.pp, dtype="float32",
+               param_dtype="float32") if args.tiny else llama3_70b()
+    mesh = make_mesh(MeshConfig(stage=args.pp, tensor=args.tp),
+                     jax.devices()[:n])
+    model = Model(cfg)
+    params = shard_params(model.init(jax.random.PRNGKey(0)), cfg, mesh)
+    cache = shard_cache(
+        init_cache(cfg, args.batch, args.prompt_len + args.max_new),
+        cfg, mesh)
+    tokens = jax.device_put(
+        jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (args.batch, args.prompt_len))),
+        NamedSharding(mesh, P()))
+
+    def step(params, tokens, cache):
+        return pipeline_forward(params, cfg, tokens, cache, mesh,
+                                num_microbatches=args.microbatches)
+
+    with jax.set_mesh(mesh):
+        (_, cache), dt_prefill = timed(jax.jit(step), params, tokens, cache)
+        one = tokens[:, :1]
+        (_, cache), dt_decode = timed(jax.jit(step), params, one, cache,
+                                      warmup=2, iters=8)
+
+    toks = args.batch / dt_decode
+    emit("llama70b_tp_pp_decode_tokens_per_sec", toks, "tokens/sec",
+         config="baseline_config_2", tp=args.tp, pp=args.pp,
+         tokens_per_sec_per_chip=round(toks / n, 2),
+         ttft_s=round(dt_prefill, 4))
+
+
+if __name__ == "__main__":
+    main()

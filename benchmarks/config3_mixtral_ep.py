@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""BASELINE config 3: Mixtral-8x7B MoE expert-parallel over ICI."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, parse_args, timed  # noqa: E402
+
+
+def main():
+    args = parse_args("Mixtral-8x7B EP", ep=4)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from butterfly_tpu.core.config import MeshConfig, mixtral_8x7b, tiny
+    from butterfly_tpu.core.mesh import make_mesh
+    from butterfly_tpu.models.common import Model, forward, init_cache
+    from butterfly_tpu.parallel.partition import shard_cache, shard_params
+
+    cfg = (tiny("mixtral", dtype="float32", param_dtype="float32")
+           if args.tiny else mixtral_8x7b()).replace(moe_impl="ep")
+    mesh = make_mesh(MeshConfig(expert=args.ep), jax.devices()[:args.ep])
+    model = Model(cfg)
+    params = shard_params(model.init(jax.random.PRNGKey(0)), cfg, mesh)
+    cache = shard_cache(
+        init_cache(cfg, args.batch, args.prompt_len + args.max_new),
+        cfg, mesh)
+    tokens = jax.device_put(
+        jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (args.batch, args.prompt_len))),
+        NamedSharding(mesh, P()))
+
+    def step(params, tokens, cache):
+        return forward(params, cfg, tokens, cache)
+
+    with jax.set_mesh(mesh):
+        (_, cache), dt_prefill = timed(jax.jit(step), params, tokens, cache)
+        one = tokens[:, :1]
+        (_, cache), dt_decode = timed(jax.jit(step), params, one, cache,
+                                      warmup=2, iters=8)
+
+    toks = args.batch / dt_decode
+    emit("mixtral_ep_decode_tokens_per_sec", toks, "tokens/sec",
+         config="baseline_config_3", ep=args.ep,
+         tokens_per_sec_per_chip=round(toks / args.ep, 2),
+         ttft_s=round(dt_prefill, 4))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""BASELINE config 4: continuous batching + paged KV (serving throughput).
+
+Submits a staggered stream of requests through the scheduler and reports
+sustained tokens/sec plus TTFT percentiles — the serving metrics of
+record (BASELINE.json north_star).
+"""
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+from common import emit, parse_args  # noqa: E402
+
+
+def main():
+    args = parse_args("continuous batching + paged KV", batch=8,
+                      prompt_len=64, max_new=64, requests=32)
+    import jax
+    import numpy as np
+    from butterfly_tpu.core.config import RuntimeConfig, llama3_8b, tiny
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    cfg = tiny("llama", dtype="float32", param_dtype="float32") \
+        if args.tiny or jax.default_backend() == "cpu" else llama3_8b()
+    rt = RuntimeConfig(max_batch_size=args.batch,
+                       max_seq_len=args.prompt_len + args.max_new,
+                       page_size=16)
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    sched = Scheduler(ServingEngine(model, params, rt))
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, args.prompt_len).tolist()
+               for _ in range(args.requests)]
+    # warmup: compile prefill + decode programs
+    sched.submit(prompts[0], max_new_tokens=2)
+    sched.run_until_done()
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        sched.submit(p, max_new_tokens=args.max_new)
+    sched.run_until_done(max_ticks=10 ** 6)
+    dt = time.perf_counter() - t0
+
+    m = sched.metrics()
+    total = args.requests * args.max_new
+    emit("serving_tokens_per_sec", total / dt, "tokens/sec",
+         config="baseline_config_4", requests=args.requests,
+         slots=args.batch,
+         ttft_p50_s=round(m.get("ttft_p50", 0), 4),
+         ttft_p95_s=round(m.get("ttft_p95", 0), 4),
+         preemptions=int(m["preemptions_total"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -120,7 +120,8 @@ def gather_paged_layer(pages: jax.Array, page_table: jax.Array) -> jax.Array:
 def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
                   cache: PagedKVCache,
                   positions: Optional[jax.Array] = None,
-                  active: Optional[jax.Array] = None):
+                  active: Optional[jax.Array] = None,
+                  use_kernel: bool = False):
     """Forward over [B,T] tokens against the paged cache.
 
     B must equal cache.num_slots (serving: one row per slot). `active`
@@ -128,10 +129,15 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     advance and their writes land on pages only they own (admission wrote
     their table), so garbage never leaks across requests. Returns
     (logits [B,T,V], updated cache).
+
+    use_kernel: decode steps (T==1) attend through the Pallas paged-
+    attention kernel — touches only each slot's live pages instead of
+    gathering the full S_max view. Prefills (T>1) honor cfg.attn_impl
+    ("flash" = Pallas blockwise kernel over the fresh K/V).
     """
     from butterfly_tpu.models.common import (
-        attend, attn_output, embed_tokens, final_logits, make_mask,
-        mlp_block, moe_block, qkv_proj, rms_norm, layer_norm)
+        attend, attn_output, embed_tokens, ffn_block, final_logits,
+        make_mask, pre_norm, qkv_proj)
     import jax as _jax
 
     B, T = tokens.shape
@@ -149,32 +155,26 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     def body(x, scanned):
         lp, kp, vp = scanned
         lp = _jax.tree.map(lambda a: a.astype(compute_dtype), lp)
-        if cfg.arch == "gpt2":
-            h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"],
-                           cfg.norm_eps)
-        else:
-            h = rms_norm(x, lp["ln1"]["scale"], cfg.norm_eps)
+        h = pre_norm(x, lp["ln1"], cfg)
         q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
         kp, vp = write_paged_layer(kp, vp, cache.page_table, k, v, start,
                                    active)
-        ck = gather_paged_layer(kp, cache.page_table)
-        cv = gather_paged_layer(vp, cache.page_table)
-        out = attend(q, ck, cv, mask, cfg)
+        if use_kernel and T == 1:
+            from butterfly_tpu.ops.paged_attention import paged_attention
+            # lengths INCLUDING the token just written (inactive: 0 -> no
+            # pages visited, output discarded)
+            lens = jnp.where(active, positions[:, 0] + 1, 0)
+            out = paged_attention(q[:, 0], kp, vp, cache.page_table,
+                                  lens)[:, None]
+        elif cfg.attn_impl == "flash" and T > 1:
+            from butterfly_tpu.ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            ck = gather_paged_layer(kp, cache.page_table)
+            cv = gather_paged_layer(vp, cache.page_table)
+            out = attend(q, ck, cv, mask, cfg)
         x = x + attn_output(out, lp["attn"], cfg)
-
-        if cfg.arch == "gpt2":
-            h = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"],
-                           cfg.norm_eps)
-        else:
-            h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
-        if cfg.is_moe:
-            if cfg.moe_impl == "ep":
-                from butterfly_tpu.parallel.expert import moe_block_ep
-                x = x + moe_block_ep(h, lp["moe"], cfg)
-            else:
-                x = x + moe_block(h, lp["moe"], cfg)
-        else:
-            x = x + mlp_block(h, lp["mlp"], cfg)
+        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
         return x, (kp, vp)
 
     x, (new_k, new_v) = lax.scan(

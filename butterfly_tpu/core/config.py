@@ -50,6 +50,12 @@ class ModelConfig:
     dtype: str = "bfloat16"           # activation/weight compute dtype
     param_dtype: str = "float32"      # master param dtype
 
+    # attention implementation: "dense" = XLA einsum attend over the cache;
+    # "flash" = Pallas blockwise kernel over the freshly-projected K/V —
+    # ONLY valid for fresh prefills (cache empty, positions 0..T-1); the
+    # engines swap it in for exactly those steps.
+    attn_impl: str = "dense"
+
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0

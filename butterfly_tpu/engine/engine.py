@@ -44,24 +44,39 @@ class InferenceEngine:
     """
 
     def __init__(self, model: Model, params, runtime: Optional[RuntimeConfig] = None,
-                 mesh=None, num_microbatches: Optional[int] = None):
+                 mesh=None, num_microbatches: Optional[int] = None,
+                 use_flash_prefill: Optional[bool] = None):
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
         self.params = params
         self.mesh = mesh
-        # One forward callable for every step: the plain single-program
+        if use_flash_prefill is None:
+            # Pallas kernels: TPU-only, and only unmeshed — inside an
+            # auto-partitioned jit a pallas_call is an opaque custom call
+            # GSPMD can't shard (wrap in shard_map before enabling there).
+            use_flash_prefill = (jax.default_backend() == "tpu"
+                                 and (mesh is None
+                                      or all(s == 1 for s in
+                                             mesh.shape.values())))
+
+        # One forward callable per step kind: the plain single-program
         # forward, or the GPipe pipeline when the mesh has stage > 1.
-        if mesh is not None and mesh.shape.get("stage", 1) > 1:
-            from butterfly_tpu.parallel.pipeline import pipeline_forward
-            fwd = lambda p, t, c, pos=None: pipeline_forward(  # noqa: E731
-                p, self.cfg, t, c, mesh, num_microbatches, pos)
-        else:
-            fwd = lambda p, t, c, pos=None: forward(  # noqa: E731
-                p, self.cfg, t, c, pos)
+        # Prefill steps are always fresh (new cache, positions 0..T-1), so
+        # they may use the Pallas flash kernel (cfg.attn_impl contract).
+        def make_fwd(cfg):
+            if mesh is not None and mesh.shape.get("stage", 1) > 1:
+                from butterfly_tpu.parallel.pipeline import pipeline_forward
+                return lambda p, t, c, pos=None: pipeline_forward(
+                    p, cfg, t, c, mesh, num_microbatches, pos)
+            return lambda p, t, c, pos=None: forward(p, cfg, t, c, pos)
+
+        fwd = make_fwd(self.cfg)
+        prefill_cfg = self.cfg.replace(attn_impl="flash") \
+            if use_flash_prefill else self.cfg
         self._fwd = fwd
         self._prefill = jax.jit(
-            partial(_prefill_step, fwd),
+            partial(_prefill_step, make_fwd(prefill_cfg)),
             donate_argnums=(2,),
         )
         self._decode = jax.jit(

@@ -58,18 +58,29 @@ class ServingEngine:
     """Device-side half of the serving stack (host half: sched/)."""
 
     def __init__(self, model: Model, params,
-                 runtime: Optional[RuntimeConfig] = None, mesh=None):
+                 runtime: Optional[RuntimeConfig] = None, mesh=None,
+                 use_kernels: Optional[bool] = None):
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
         self.params = params
         self.mesh = mesh
+        if use_kernels is None:
+            # Pallas kernels: TPU-only, and only unmeshed (a pallas_call
+            # inside an auto-partitioned jit is an opaque custom call
+            # GSPMD can't shard — wrap in shard_map before enabling).
+            use_kernels = (jax.default_backend() == "tpu"
+                           and (mesh is None
+                                or all(s == 1 for s in
+                                       mesh.shape.values())))
         self.cache = init_paged_cache(self.cfg, self.runtime)
+        prefill_cfg = self.cfg.replace(attn_impl="flash") \
+            if use_kernels else self.cfg
         self._prefill = jax.jit(
-            partial(_prefill_slot, self.cfg), donate_argnums=(2, 3))
+            partial(_prefill_slot, prefill_cfg), donate_argnums=(2, 3))
         self._decode = jax.jit(
-            partial(_decode_all, self.cfg), static_argnums=(5, 6),
-            donate_argnums=(2,))
+            partial(_decode_all, self.cfg, use_kernel=use_kernels),
+            static_argnums=(5, 6), donate_argnums=(2,))
 
     @property
     def num_slots(self) -> int:
@@ -139,9 +150,10 @@ def _prefill_slot(cfg: ModelConfig, params, tokens, k_pages, v_pages,
 
 
 def _decode_all(cfg: ModelConfig, params, tokens, cache: PagedKVCache,
-                active, temps, top_k: int, top_p: float, key):
+                active, temps, top_k: int, top_p: float, key,
+                use_kernel: bool = False):
     logits, cache = paged_forward(params, cfg, tokens[:, None], cache,
-                                  active=active)
+                                  active=active, use_kernel=use_kernel)
     last = logits[:, -1, :]
     nxt = sample_batched(last, key, temps, top_k, top_p)
     return nxt, last, cache

@@ -195,7 +195,14 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
     q, k, v = qkv_proj(x, p, cfg, cos, sin)
     start = positions[:, 0]  # write offset per sequence
     ck, cv = update_cache_layer(ck, cv, k, v, start)
-    out = attend(q, ck, cv, mask, cfg)
+    if cfg.attn_impl == "flash" and x.shape[1] > 1:
+        # fresh-prefill contract (see ModelConfig.attn_impl): attend over
+        # the just-projected K/V with the Pallas kernel; cache still
+        # written above for the decode steps that follow.
+        from butterfly_tpu.ops.flash_attention import flash_attention
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = attend(q, ck, cv, mask, cfg)
     return attn_output(out, p, cfg), ck, cv
 
 
@@ -234,33 +241,36 @@ def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum("ebtd,bte->btd", y, comb.astype(y.dtype))
 
 
+def pre_norm(x: jax.Array, norm_p: Params, cfg: ModelConfig) -> jax.Array:
+    """The arch's norm (LayerNorm for gpt2, RMSNorm otherwise)."""
+    if cfg.arch == "gpt2":
+        return layer_norm(x, norm_p["scale"], norm_p["bias"], cfg.norm_eps)
+    return rms_norm(x, norm_p["scale"], cfg.norm_eps)
+
+
+def ffn_block(h: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
+    """FFN dispatch shared by every forward variant (contiguous, paged,
+    pipeline, sequence-parallel): dense MLP, dense MoE, or EP MoE per
+    cfg — one definition so the variants can't drift."""
+    if cfg.is_moe:
+        if cfg.moe_impl == "ep":
+            from butterfly_tpu.parallel.expert import moe_block_ep
+            return moe_block_ep(h, lp["moe"], cfg)
+        return moe_block(h, lp["moe"], cfg)
+    return mlp_block(h, lp["mlp"], cfg)
+
+
 def transformer_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
                       ck: jax.Array, cv: jax.Array,
                       positions: jax.Array, mask: jax.Array,
                       cos: jax.Array, sin: jax.Array
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pre-norm residual block: x + attn(norm(x)); x + ffn(norm(x))."""
-    if cfg.arch == "gpt2":
-        h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], cfg.norm_eps)
-    else:
-        h = rms_norm(x, lp["ln1"]["scale"], cfg.norm_eps)
+    h = pre_norm(x, lp["ln1"], cfg)
     attn_out, ck, cv = attention_block(h, lp["attn"], cfg, ck, cv,
                                        positions, mask, cos, sin)
     x = x + attn_out
-
-    if cfg.arch == "gpt2":
-        h = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], cfg.norm_eps)
-    else:
-        h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
-    if cfg.is_moe:
-        if cfg.moe_impl == "ep":
-            from butterfly_tpu.parallel.expert import moe_block_ep
-            ffn_out = moe_block_ep(h, lp["moe"], cfg)
-        else:
-            ffn_out = moe_block(h, lp["moe"], cfg)
-    else:
-        ffn_out = mlp_block(h, lp["mlp"], cfg)
-    x = x + ffn_out
+    x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
     return x, ck, cv
 
 

@@ -1,0 +1,84 @@
+"""Mixtral-8x7B MoE family (BASELINE.json configs[3], expert-parallel).
+
+Llama-style attention (GQA + RoPE) with a top-2-of-8 expert SwiGLU FFN;
+expressed via ModelConfig over models/common.py, with the expert-parallel
+dispatch in parallel/expert.py (cfg.moe_impl="ep").
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.core.config import ModelConfig, mixtral_8x7b  # noqa: F401
+from butterfly_tpu.models.common import Model
+
+
+def model(cfg: ModelConfig | None = None) -> Model:
+    return Model(cfg or mixtral_8x7b())
+
+
+def params_from_hf_state_dict(sd: Dict[str, Any], cfg: ModelConfig) -> Dict:
+    """Convert HF MixtralForCausalLM weights to our pytree.
+
+    HF expert weights live at
+    model.layers.{l}.block_sparse_moe.experts.{e}.w1|w2|w3.weight with
+    w1=gate [F,D], w2=down [D,F], w3=up [F,D]; the router is
+    block_sparse_moe.gate.weight [E,D]. Our layout stacks layers AND
+    experts: w_gate/w_up [L,E,D,F], w_down [L,E,F,D], router [L,D,E].
+    """
+    def g(name):
+        t = sd[name]
+        return np.asarray(
+            t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+            dtype=np.float32)
+
+    L, D = cfg.num_layers, cfg.hidden_size
+    Nq, Kv, H, E = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.num_experts
+
+    def stack(fmt, post=lambda a: a):
+        return jnp.asarray(np.stack([post(g(fmt.format(i)))
+                                     for i in range(L)]))
+
+    def proj(n_heads):
+        return lambda a: a.T.reshape(D, n_heads, H)
+
+    def experts(which):  # w1|w2|w3 -> [L,E,...] transposed to [in,out]
+        return jnp.asarray(np.stack([
+            np.stack([g(f"model.layers.{l}.block_sparse_moe.experts."
+                        f"{e}.{which}.weight").T for e in range(E)])
+            for l in range(L)]))
+
+    params = {
+        "embed": {"tok": jnp.asarray(g("model.embed_tokens.weight"))},
+        "layers": {
+            "ln1": {"scale": stack("model.layers.{}.input_layernorm.weight")},
+            "ln2": {"scale": stack(
+                "model.layers.{}.post_attention_layernorm.weight")},
+            "attn": {
+                "wq": stack("model.layers.{}.self_attn.q_proj.weight",
+                            proj(Nq)),
+                "wk": stack("model.layers.{}.self_attn.k_proj.weight",
+                            proj(Kv)),
+                "wv": stack("model.layers.{}.self_attn.v_proj.weight",
+                            proj(Kv)),
+                "wo": stack("model.layers.{}.self_attn.o_proj.weight",
+                            post=lambda a: a.T.reshape(Nq, H, D)),
+            },
+            "moe": {
+                "router": stack(
+                    "model.layers.{}.block_sparse_moe.gate.weight",
+                    post=lambda a: a.T),              # [D,E]
+                "w_gate": experts("w1"),
+                "w_up": experts("w3"),
+                "w_down": experts("w2"),
+            },
+        },
+        "final_norm": {"scale": jnp.asarray(g("model.norm.weight"))},
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = jnp.asarray(g("lm_head.weight").T)
+    else:
+        params["lm_head"] = jnp.asarray(g("model.embed_tokens.weight").T)
+    return params

@@ -1,0 +1,64 @@
+"""Profiling/tracing hooks (SURVEY.md §5: jax.profiler + named scopes).
+
+The reference only *planned* observability (/root/reference/CLAUDE.md:42);
+the TPU-native mechanism is XProf: `trace()` captures a TensorBoard-
+loadable profile of any code region (XLA ops, Pallas kernels, collectives,
+host activity), `start_profiler_server()` enables on-demand capture from
+a live serving process, and `step_timer` is a zero-dependency host-side
+ring buffer for per-tick latency percentiles.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XProf trace of the enclosed region into `logdir`."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler_server(port: int = 9999) -> None:
+    """On-demand profiling for live servers (connect with TensorBoard)."""
+    import jax
+    jax.profiler.start_server(port)
+
+
+def annotate(name: str):
+    """Named region: shows up in XProf timelines (jax.named_scope)."""
+    import jax
+    return jax.named_scope(name)
+
+
+class StepTimer:
+    """Host-side ring buffer of step latencies -> percentiles."""
+
+    def __init__(self, capacity: int = 1024):
+        self._lat = deque(maxlen=capacity)
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._lat.append(time.perf_counter() - t0)
+
+    def percentiles(self) -> Dict[str, float]:
+        if not self._lat:
+            return {}
+        a = np.asarray(self._lat)
+        return {"step_p50_s": float(np.percentile(a, 50)),
+                "step_p95_s": float(np.percentile(a, 95)),
+                "step_p99_s": float(np.percentile(a, 99)),
+                "steps_recorded": float(len(a))}

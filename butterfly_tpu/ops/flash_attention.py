@@ -1,0 +1,132 @@
+"""Pallas flash attention (TPU/Mosaic): blockwise causal self-attention.
+
+The prefill-side hot kernel (SURVEY.md §2.2 C4/C5 "hand-written kernels go
+in Pallas — the TPU-idiomatic replacement for the CUDA kernels the north
+star attributes to the original design"). Design:
+
+* grid (B, Nq, Tq/BQ, S/BK); the last axis is a reduction ("arbitrary")
+  dimension — the out block's index map ignores it, so the same out tile
+  stays VMEM-resident while K/V blocks stream through, and the online-
+  softmax state (m, l, acc f32 scratch) carries across it.
+* Causality works on absolute positions (q_pos >= k_pos); blocks entirely
+  in the future contribute nothing (their exp() underflows to 0 via the
+  -inf mask — no branch divergence, MXU stays busy on the diagonal).
+* GQA: q head n reads k/v head n // (Nq/Kv) via the k/v index maps — no
+  materialized head broadcast.
+* Off-TPU the wrapper runs the same kernel in interpreter mode, so CPU
+  tests validate the exact kernel code path numerics.
+
+Used by the engine for fresh prefills (cfg.attn_impl="flash"); decode-side
+paged attention lives in ops/paged_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, bq: int, bk: int, seq_len: int, causal: bool):
+    i = pl.program_id(2)          # q block
+    j = pl.program_id(3)          # k block (reduction axis)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)            # [BQ, H]
+    k = k_ref[0, 0].astype(jnp.float32)            # [BK, H]
+    v = v_ref[0, 0].astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = k_pos < seq_len                          # padded keys
+    if causal:
+        mask = mask & (q_pos >= k_pos)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_blk = jnp.max(s, axis=-1, keepdims=True)      # [BQ, 1]
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+    l_ref[:] = l_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """Blockwise (flash) attention over fresh Q/K/V.
+
+    q: [B, T, Nq, H]; k/v: [B, T, Kv, H] (same T: self-attention).
+    Returns [B, T, Nq, H] in q.dtype. Softmax/accum in f32.
+    """
+    B, T, Nq, H = q.shape
+    Kv = k.shape[2]
+    G = Nq // Kv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bq = min(block_q, max(T, 8))
+    bk = min(block_k, max(T, 8))
+    Tq = -(-T // bq) * bq
+    Tk = -(-T // bk) * bk
+
+    qt = jnp.moveaxis(q, 2, 1)                      # [B, Nq, T, H]
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Tq - T), (0, 0)))
+    kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Tk - T), (0, 0)))
+    vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Tk - T), (0, 0)))
+
+    grid = (B, Nq, Tq // bq, Tk // bk)
+    kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, seq_len=T,
+                               causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, H), lambda b, n, i, j: (b, n, i, 0)),
+            pl.BlockSpec((1, 1, bk, H),
+                         lambda b, n, i, j, G=G: (b, n // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, H),
+                         lambda b, n, i, j, G=G: (b, n // G, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, H),
+                               lambda b, n, i, j: (b, n, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Nq, Tq, H), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),       # running max
+            pltpu.VMEM((bq, 1), jnp.float32),       # running denom
+            pltpu.VMEM((bq, H), jnp.float32),       # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.moveaxis(out[:, :, :T, :], 1, 2)     # [B, T, Nq, H]

@@ -1,0 +1,132 @@
+"""Pallas paged attention: decode-step attention over the paged KV pool.
+
+The decode-side hot kernel for continuous batching (BASELINE.json
+configs[4]). The reference gather path (cache/paged.py gather_paged_layer)
+materializes every slot's full [S_max] K/V view — reading null pages and
+unallocated tail pages for short sequences. This kernel instead walks each
+slot's block table and touches ONLY its live pages:
+
+* `PrefetchScalarGridSpec(num_scalar_prefetch=2)`: the block table and
+  lengths arrive before the body runs, so the K/V BlockSpec *index maps*
+  dereference `table[slot, j]` — the DMA engine streams exactly the pages
+  the slot owns, straight from HBM, double-buffered by the Mosaic
+  pipeline. This is the TPU analogue of vLLM's CUDA paged-attention
+  gather, with the page walk moved into the grid index maps.
+* grid (slots, max_pages): per-slot online softmax across its pages
+  (f32 scratch, same recurrence as ops/flash_attention.py); pages at or
+  past the slot's length are predicated off with `pl.when` (their DMA
+  still runs — at one page it is cheaper than a branchy pipeline).
+* Decode has one query token per slot, so the MXU sees [Nq, H] x
+  [H, page] per step — small, but the kernel is bandwidth-bound and reads
+  ceil(len/page) pages instead of S_max.
+
+Off-TPU the wrapper runs the kernel in interpreter mode (CPU tests cover
+the exact kernel path).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, page: int, kv_heads: int):
+    slot = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    length = len_ref[slot]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * page < length)
+    def _compute():
+        # Mosaic-friendly GQA: ONE 2D matmul against the flattened
+        # [page*Kv, H] block, with cross-group scores masked off. The
+        # Kv-fold column redundancy is tiny (page*Kv cols) and keeps
+        # everything on the plain MXU path (batched matmuls with
+        # mismatched batch dims don't lower).
+        q = q_ref[0].astype(jnp.float32)               # [Nq, H]
+        kf = k_ref[0].astype(jnp.float32).reshape(page * kv_heads, -1)
+        vf = v_ref[0].astype(jnp.float32).reshape(page * kv_heads, -1)
+        Nq, H = q.shape
+        G = Nq // kv_heads
+        scale = jax.lax.rsqrt(jnp.asarray(H, jnp.float32))
+
+        s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32) * scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, (Nq, page * kv_heads), 1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Nq, page * kv_heads), 0)
+        col_kv, col_p = cols % kv_heads, cols // kv_heads
+        group_ok = col_kv == rows // G                 # head n <-> kv n//G
+        pos = j * page + col_p
+        mask = group_ok & (pos < length)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Nq, page*Kv]
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+            p, vf, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_table: jax.Array, lengths: jax.Array,
+                    interpret: bool | None = None) -> jax.Array:
+    """Single-token attention over each slot's paged KV.
+
+    q: [slots, Nq, H] (the one decode token per slot, post-rope);
+    k_pages/v_pages: [P, page, Kv, H] (one layer's pool);
+    page_table: [slots, max_pages] int32; lengths: [slots] int32 —
+    number of cache tokens INCLUDING the just-written current token.
+    Returns [slots, Nq, H].
+    """
+    S, Nq, H = q.shape
+    Pp, page, Kv, H2 = k_pages.shape
+    max_pages = page_table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
+            pl.BlockSpec((1, page, Kv, H),
+                         lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, Kv, H),
+                         lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Nq, 1), jnp.float32),
+            pltpu.VMEM((Nq, 1), jnp.float32),
+            pltpu.VMEM((Nq, H), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, page=page, kv_heads=Kv)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Nq, H), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table, lengths, q, k_pages, v_pages)

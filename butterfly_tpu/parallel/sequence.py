@@ -37,8 +37,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from butterfly_tpu.core.config import ModelConfig
 from butterfly_tpu.models.common import (
-    KVCache, Params, embed_tokens, final_logits, mlp_block, moe_block,
-    qkv_proj, attn_output, rms_norm, layer_norm, rope_freqs)
+    KVCache, Params, attn_output, embed_tokens, ffn_block, final_logits,
+    pre_norm, qkv_proj)
 
 NEG = -1e30
 
@@ -164,27 +164,14 @@ def _sp_body(layers, head, tokens, *, cfg: ModelConfig, impl: str):
 
     def layer(x, lp):
         lp = jax.tree.map(lambda a: a.astype(compute_dtype), lp)
-        if cfg.arch == "gpt2":
-            h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"],
-                           cfg.norm_eps)
-        else:
-            h = rms_norm(x, lp["ln1"]["scale"], cfg.norm_eps)
+        h = pre_norm(x, lp["ln1"], cfg)
         q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
         if impl == "ring":
             out = ring_attention(q, k, v, positions, positions)
         else:
             out = ulysses_attention(q, k, v, positions)
         x = x + attn_output(out, lp["attn"], cfg)
-
-        if cfg.arch == "gpt2":
-            h = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"],
-                           cfg.norm_eps)
-        else:
-            h = rms_norm(x, lp["ln2"]["scale"], cfg.norm_eps)
-        if cfg.is_moe:
-            x = x + moe_block(h, lp["moe"], cfg)
-        else:
-            x = x + mlp_block(h, lp["mlp"], cfg)
+        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
         return x, (k.astype(compute_dtype), v.astype(compute_dtype))
 
     x, (ks, vs) = lax.scan(layer, x, layers)

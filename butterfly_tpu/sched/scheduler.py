@@ -118,6 +118,25 @@ class Scheduler:
             self.waiting.remove(req)
         self._finish(req, state="cancelled")
 
+    def abort_all(self) -> None:
+        """Wedge-path drain: host-only bookkeeping, NO device calls (the
+        device may be the thing that's broken). Every waiter's on_finish
+        fires; slots/pages are reclaimed in host state only."""
+        for req in list(self.running) + list(self.waiting):
+            req.state = "cancelled"
+            req.t_finish = time.monotonic()
+            if req.slot is not None:
+                self.alloc.release(req.slot)
+                self.slots[req.slot] = None
+                req.slot = None
+            if req.on_finish is not None:
+                try:
+                    req.on_finish(req)
+                except Exception:
+                    pass
+        self.running.clear()
+        self.waiting.clear()
+
     @property
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)

@@ -52,10 +52,9 @@ class ServerState:
             except Exception as e:  # device/OOM errors must not wedge
                 self.error = f"{type(e).__name__}: {e}"
                 with self.lock:
-                    # unblock every waiter (on_finish sentinels fire)
-                    for req in list(self.sched.running) + list(
-                            self.sched.waiting):
-                        self.sched.cancel(req)
+                    # host-only drain — cancel() would touch the (possibly
+                    # dead) device via engine.reset_slot
+                    self.sched.abort_all()
                 continue
             if has_work:
                 if made:
@@ -188,6 +187,11 @@ def make_handler(state: ServerState):
                     if tok is None:
                         break
                     toks.append(tok)
+                if req.state == "cancelled":
+                    self._json(503, {"error": "generation aborted: "
+                                     + (state.error or "cancelled"),
+                                     "partial_tokens": toks})
+                    return
                 self._json(200, {
                     "tokens": toks,
                     "text": state.tok.decode(toks),
@@ -226,7 +230,12 @@ def make_handler(state: ServerState):
                     piece = state.tok.decode([tok])
                     msg = json.dumps({"token": tok, "text": piece})
                     chunk(f"data: {msg}\n\n".encode())
-                chunk(b"data: [DONE]\n\n")
+                if req.state == "cancelled":
+                    err = json.dumps({"error": "generation aborted: "
+                                      + (state.error or "cancelled")})
+                    chunk(f"data: {err}\n\n".encode())
+                else:
+                    chunk(b"data: [DONE]\n\n")
                 chunk(b"")  # terminating chunk
             except (BrokenPipeError, ConnectionResetError):
                 # client went away: stop generating for a dead socket

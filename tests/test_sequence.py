@@ -80,14 +80,21 @@ def test_ulysses_matches_dense():
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("impl,arch", [
-    ("ring", "llama"), ("ulysses", "llama"), ("ring", "mixtral"),
+@pytest.mark.parametrize("impl,arch,moe_impl", [
+    ("ring", "llama", None), ("ulysses", "llama", None),
+    ("ring", "mixtral", "dense"), ("ring", "mixtral", "ep"),
 ])
-def test_sp_forward_parity(impl, arch):
-    """Whole-model SP prefill matches the plain forward (logits + cache)."""
+def test_sp_forward_parity(impl, arch, moe_impl):
+    """Whole-model SP prefill matches the plain forward (logits + cache).
+
+    The mixtral/ep case checks the EP dispatch inside the seq-manual
+    shard_map (no-drop capacity -> exact parity with dense)."""
+    kw = {}
+    if moe_impl:
+        kw = dict(moe_impl=moe_impl, moe_capacity_factor=4.0)  # C=k*T
     cfg = tiny(arch, vocab_size=256, hidden_size=64, num_heads=8,
                num_kv_heads=8, head_dim=8, intermediate_size=128,
-               dtype="float32", param_dtype="float32")
+               dtype="float32", param_dtype="float32", **kw)
     mesh = make_mesh(MeshConfig(seq=4, data=2))
     params = Model(cfg).init(jax.random.PRNGKey(0))
     B, T = 2, 24
