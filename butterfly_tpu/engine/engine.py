@@ -49,7 +49,10 @@ class InferenceEngine:
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
-        self.params = params
+        # Inference reads every weight every step: keep params in the
+        # compute dtype so the decode loop streams half the HBM bytes
+        # (the in-scan cast then no-ops and XLA elides it).
+        self.params = cast_params(params, self.cfg)
         self.mesh = mesh
         if use_flash_prefill is None:
             # Pallas kernels: TPU-only, and only unmeshed — inside an
@@ -231,6 +234,27 @@ def _mask_after_stop(out: np.ndarray, lens: np.ndarray, stop: int) -> np.ndarray
     out = out.copy()
     out[mask] = stop
     return out
+
+
+def cast_params(params, cfg: ModelConfig):
+    """One-time cast of the weight pytree to the compute dtype.
+
+    Device-resident cast (jit, donating the source) so a 70B f32 tree
+    never round-trips the host; sharded inputs keep their shardings.
+    """
+    target = jnp.dtype(cfg.dtype)
+    leaves = jax.tree.leaves(params)
+    if all(a.dtype == target or not jnp.issubdtype(a.dtype, jnp.floating)
+           for a in leaves):
+        return params
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def cast(p):
+        return jax.tree.map(
+            lambda a: a.astype(target)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
+    return cast(params)
 
 
 def pad_prompts(prompts: Sequence[Sequence[int]], pad_id: int = 0

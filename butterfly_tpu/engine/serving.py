@@ -60,10 +60,11 @@ class ServingEngine:
     def __init__(self, model: Model, params,
                  runtime: Optional[RuntimeConfig] = None, mesh=None,
                  use_kernels: Optional[bool] = None):
+        from butterfly_tpu.engine.engine import cast_params
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
-        self.params = params
+        self.params = cast_params(params, self.cfg)
         self.mesh = mesh
         if use_kernels is None:
             # Pallas kernels: TPU-only, and only unmeshed (a pallas_call
