@@ -1,0 +1,32 @@
+import time, json
+import jax, jax.numpy as jnp
+
+B, D, F, L = 64, 2048, 19200, 16
+key = jax.random.PRNGKey(1)
+Wb = jax.random.normal(key, (L, D, F), jnp.bfloat16)
+W8 = (jax.random.normal(key, (L, D, F)) * 50).astype(jnp.int8)
+s  = jnp.ones((L, 1, F), jnp.bfloat16) * 0.02
+xx = jax.random.normal(jax.random.PRNGKey(2), (B, D), jnp.bfloat16)
+
+def timed(f, *a):
+    f(*a); t0=time.perf_counter(); float(f(*a)); return time.perf_counter()-t0
+
+def make(fn, n=16):
+    @jax.jit
+    def g(xx, *w):
+        def outer(c, _):
+            def body(c2, wi):
+                return fn(c2, wi), None
+            c, _ = jax.lax.scan(body, c, w if len(w)>1 else w[0])
+            return c, None
+        c, _ = jax.lax.scan(outer, xx, None, length=n)
+        return c.astype(jnp.float32).sum()
+    return g
+
+bf = make(lambda c, wi: (c @ wi)[:, :D] + c)
+q8 = make(lambda c, wi: ((c @ wi[0].astype(jnp.bfloat16)) * wi[1])[:, :D] + c)
+
+t_bf = timed(bf, xx, Wb)
+t_q8 = timed(q8, xx, (W8, s))
+print(json.dumps({"bf16_s": round(t_bf,3), "int8_s": round(t_q8,3),
+                  "marginal_speedup": round((t_bf-0.08)/(t_q8-0.08), 2)}), flush=True)

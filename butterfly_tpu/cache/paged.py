@@ -121,7 +121,8 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
                   cache: PagedKVCache,
                   positions: Optional[jax.Array] = None,
                   active: Optional[jax.Array] = None,
-                  use_kernel: bool = False):
+                  use_kernel: bool = False,
+                  fresh: bool = False):
     """Forward over [B,T] tokens against the paged cache.
 
     B must equal cache.num_slots (serving: one row per slot). `active`
@@ -154,7 +155,8 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
 
     def body(x, scanned):
         lp, kp, vp = scanned
-        lp = _jax.tree.map(lambda a: a.astype(compute_dtype), lp)
+        from butterfly_tpu.models.common import _cast_float
+        lp = _jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
         h = pre_norm(x, lp["ln1"], cfg)
         q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
         kp, vp = write_paged_layer(kp, vp, cache.page_table, k, v, start,
@@ -166,7 +168,7 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
             lens = jnp.where(active, positions[:, 0] + 1, 0)
             out = paged_attention(q[:, 0], kp, vp, cache.page_table,
                                   lens)[:, None]
-        elif cfg.attn_impl == "flash" and T > 1:
+        elif cfg.attn_impl == "flash" and T > 1 and fresh:
             from butterfly_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
         else:

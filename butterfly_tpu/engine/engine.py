@@ -67,19 +67,20 @@ class InferenceEngine:
         # forward, or the GPipe pipeline when the mesh has stage > 1.
         # Prefill steps are always fresh (new cache, positions 0..T-1), so
         # they may use the Pallas flash kernel (cfg.attn_impl contract).
-        def make_fwd(cfg):
+        def make_fwd(cfg, fresh=False):
             if mesh is not None and mesh.shape.get("stage", 1) > 1:
                 from butterfly_tpu.parallel.pipeline import pipeline_forward
                 return lambda p, t, c, pos=None: pipeline_forward(
-                    p, cfg, t, c, mesh, num_microbatches, pos)
-            return lambda p, t, c, pos=None: forward(p, cfg, t, c, pos)
+                    p, cfg, t, c, mesh, num_microbatches, pos, fresh=fresh)
+            return lambda p, t, c, pos=None: forward(p, cfg, t, c, pos,
+                                                     fresh=fresh)
 
         fwd = make_fwd(self.cfg)
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
             if use_flash_prefill else self.cfg
         self._fwd = fwd
         self._prefill = jax.jit(
-            partial(_prefill_step, make_fwd(prefill_cfg)),
+            partial(_prefill_step, make_fwd(prefill_cfg, fresh=True)),
             donate_argnums=(2,),
         )
         self._decode = jax.jit(
@@ -141,9 +142,9 @@ class InferenceEngine:
             first = sample(logits, first_key, sp)
 
             if fused:
-                out, lens = self._generate_fused(self.params, first, cache,
-                                                 loop_key, sp,
-                                                 sp.max_new_tokens)
+                out, lens, _ = self._generate_fused(self.params, first,
+                                                    cache, loop_key, sp,
+                                                    sp.max_new_tokens)
                 out, lens = np.asarray(out), np.asarray(lens)
             else:
                 toks = [np.asarray(first)]
@@ -206,11 +207,14 @@ def _generate_fused(fwd, params, first, cache, key,
 
     done0 = (first == sp.stop_token) if sp.stop_token >= 0 \
         else jnp.zeros_like(first, dtype=bool)
-    _, toks = jax.lax.scan(
+    (_, cache, _, _), toks = jax.lax.scan(
         body, (first, cache, key, done0), None, length=max_new - 1)
     out = jnp.concatenate([first[:, None], toks.T], axis=1)  # [B, max_new]
     lens = _stop_lengths_jnp(out, sp.stop_token)
-    return out, lens
+    # The final cache is returned (and ignored by callers) purely so the
+    # donated input cache has an output to alias — otherwise XLA keeps a
+    # second full KV pool live for the whole scan.
+    return out, lens, cache
 
 
 def _stop_lengths_jnp(out: jax.Array, stop: int) -> jax.Array:

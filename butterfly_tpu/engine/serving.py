@@ -66,6 +66,11 @@ class ServingEngine:
         self.runtime = runtime or RuntimeConfig()
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
+        if mesh is not None and mesh.shape.get("stage", 1) > 1:
+            raise NotImplementedError(
+                "stage-parallel serving is not supported yet: the paged "
+                "decode path scans the full layer stack; use tensor/data "
+                "axes (pipeline serving tracked for a later round)")
         if use_kernels is None:
             # Pallas kernels: TPU-only, and only unmeshed (a pallas_call
             # inside an auto-partitioned jit is an opaque custom call
@@ -75,6 +80,14 @@ class ServingEngine:
                                 or all(s == 1 for s in
                                        mesh.shape.values())))
         self.cache = init_paged_cache(self.cfg, self.runtime)
+        if mesh is not None:
+            # Megatron param layout + paged pool sharded to match (kv
+            # heads over `tensor`, slots over `data`): prefill/decode
+            # below then compile to one SPMD program over the mesh.
+            from butterfly_tpu.parallel.partition import (
+                shard_paged_cache, shard_params)
+            self.params = shard_params(self.params, self.cfg, mesh)
+            self.cache = shard_paged_cache(self.cache, self.cfg, mesh)
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
             if use_kernels else self.cfg
         self._prefill = jax.jit(
@@ -82,6 +95,11 @@ class ServingEngine:
         self._decode = jax.jit(
             partial(_decode_all, self.cfg, use_kernel=use_kernels),
             static_argnums=(5, 6), donate_argnums=(2,))
+
+    def _mesh_ctx(self):
+        import contextlib
+        return jax.set_mesh(self.mesh) if self.mesh is not None \
+            else contextlib.nullcontext()
 
     @property
     def num_slots(self) -> int:
@@ -92,37 +110,42 @@ class ServingEngine:
         row = np.full((self.cache.page_table.shape[1],),
                       self.cache.null_page, np.int32)
         row[:len(pages)] = pages
-        self.cache = self.cache._replace(
-            page_table=self.cache.page_table.at[slot].set(jnp.asarray(row)))
+        with self._mesh_ctx():
+            self.cache = self.cache._replace(
+                page_table=self.cache.page_table.at[slot].set(
+                    jnp.asarray(row)))
 
     def reset_slot(self, slot: int) -> None:
-        self.cache = self.cache._replace(
-            page_table=self.cache.page_table.at[slot].set(
-                self.cache.null_page),
-            lengths=self.cache.lengths.at[slot].set(0))
+        with self._mesh_ctx():
+            self.cache = self.cache._replace(
+                page_table=self.cache.page_table.at[slot].set(
+                    self.cache.null_page),
+                lengths=self.cache.lengths.at[slot].set(0))
 
     def prefill_slot(self, slot: int, prompt: list[int]) -> jax.Array:
         """Run one request's prompt; returns last-token logits [V]."""
         T = bucket_len(len(prompt))
         tokens = np.zeros((1, T), np.int32)
         tokens[0, :len(prompt)] = prompt
-        logits, k_pages, v_pages = self._prefill(
-            self.params, jnp.asarray(tokens), self.cache.k_pages,
-            self.cache.v_pages, self.cache.page_table[slot][None],
-            jnp.asarray([len(prompt)], jnp.int32))
-        self.cache = self.cache._replace(
-            k_pages=k_pages, v_pages=v_pages,
-            lengths=self.cache.lengths.at[slot].set(len(prompt)))
+        with self._mesh_ctx():
+            logits, k_pages, v_pages = self._prefill(
+                self.params, jnp.asarray(tokens), self.cache.k_pages,
+                self.cache.v_pages, self.cache.page_table[slot][None],
+                jnp.asarray([len(prompt)], jnp.int32))
+            self.cache = self.cache._replace(
+                k_pages=k_pages, v_pages=v_pages,
+                lengths=self.cache.lengths.at[slot].set(len(prompt)))
         return logits[0]
 
     def decode_active(self, tokens: np.ndarray, active: np.ndarray,
                       temps: np.ndarray, key: jax.Array
                       ) -> Tuple[np.ndarray, jax.Array]:
         """One decode step for every slot; returns (next tokens [S], logits)."""
-        nxt, logits, cache = self._decode(
-            self.params, jnp.asarray(tokens), self.cache,
-            jnp.asarray(active), jnp.asarray(temps),
-            self.runtime_top_k, self.runtime_top_p, key)
+        with self._mesh_ctx():
+            nxt, logits, cache = self._decode(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(active), jnp.asarray(temps),
+                self.runtime_top_k, self.runtime_top_p, key)
         self.cache = cache
         return np.asarray(nxt), logits
 
@@ -145,7 +168,8 @@ def _prefill_slot(cfg: ModelConfig, params, tokens, k_pages, v_pages,
                           jnp.zeros((1,), jnp.int32))
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    logits, cache1 = paged_forward(params, cfg, tokens, cache1, positions)
+    logits, cache1 = paged_forward(params, cfg, tokens, cache1, positions,
+                                   fresh=True)
     last = jnp.take_along_axis(logits, (true_len - 1)[:, None, None], axis=1)
     return last[:, 0, :], cache1.k_pages, cache1.v_pages
 

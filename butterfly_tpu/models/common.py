@@ -29,8 +29,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from butterfly_tpu.core.config import ModelConfig
+from butterfly_tpu.quant.int8 import maybe_dequant
 
 Params = Dict[str, Any]
+
+
+def _cast_float(a: jax.Array, dtype) -> jax.Array:
+    """Cast to the compute dtype, leaving integer (e.g. int8) leaves alone."""
+    return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
 
 
 class KVCache(NamedTuple):
@@ -162,9 +168,10 @@ def qkv_proj(x: jax.Array, p: Params, cfg: ModelConfig,
              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """QKV projections (+bias, +rope). x: [B,T,D] -> q [B,T,Nq,H],
     k/v [B,T,Kv,H]. Shared by the contiguous and paged attention paths."""
-    q = jnp.einsum("btd,dnh->btnh", x, p["wq"])
-    k = jnp.einsum("btd,dkh->btkh", x, p["wk"])
-    v = jnp.einsum("btd,dkh->btkh", x, p["wv"])
+    dt = x.dtype
+    q = jnp.einsum("btd,dnh->btnh", x, maybe_dequant(p["wq"], dt))
+    k = jnp.einsum("btd,dkh->btkh", x, maybe_dequant(p["wk"], dt))
+    v = jnp.einsum("btd,dkh->btkh", x, maybe_dequant(p["wv"], dt))
     if cfg.use_bias:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -177,7 +184,7 @@ def qkv_proj(x: jax.Array, p: Params, cfg: ModelConfig,
 
 def attn_output(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     """Output projection of the attention sublayer. out: [B,T,Nq,H]."""
-    out = jnp.einsum("btnh,nhd->btd", out, p["wo"])
+    out = jnp.einsum("btnh,nhd->btd", out, maybe_dequant(p["wo"], out.dtype))
     if cfg.use_bias:
         out = out + p["bo"]
     return out
@@ -186,19 +193,23 @@ def attn_output(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
 def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
                     ck: jax.Array, cv: jax.Array,
                     positions: jax.Array, mask: jax.Array,
-                    cos: jax.Array, sin: jax.Array
+                    cos: jax.Array, sin: jax.Array,
+                    fresh: bool = False
                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One attention sublayer with contiguous-cache update.
 
     x: [B,T,D]; ck/cv: [B,S,Kv,H]; positions: [B,T]; mask: [B,T,S].
+    `fresh` (static) asserts the cache holds nothing before this call
+    (positions start at 0) — required to take the flash path, which
+    attends only over the freshly projected K/V. Warm multi-token calls
+    (chunked prefill / continuation) fall back to dense cache attention
+    even when cfg.attn_impl == "flash", so prior context is never
+    silently dropped.
     """
     q, k, v = qkv_proj(x, p, cfg, cos, sin)
     start = positions[:, 0]  # write offset per sequence
     ck, cv = update_cache_layer(ck, cv, k, v, start)
-    if cfg.attn_impl == "flash" and x.shape[1] > 1:
-        # fresh-prefill contract (see ModelConfig.attn_impl): attend over
-        # the just-projected K/V with the Pallas kernel; cache still
-        # written above for the decode steps that follow.
+    if cfg.attn_impl == "flash" and x.shape[1] > 1 and fresh:
         from butterfly_tpu.ops.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=True)
     else:
@@ -208,16 +219,17 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
 
 def mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     act = ACTIVATIONS[cfg.act]
+    dt = x.dtype
     if cfg.arch == "gpt2":
-        h = jnp.einsum("btd,df->btf", x, p["w_up"]) + p["b_up"]
-        h = act(h)
-        out = jnp.einsum("btf,fd->btd", h, p["w_down"]) + p["b_down"]
-        return out
+        h = jnp.einsum("btd,df->btf", x, maybe_dequant(p["w_up"], dt))
+        h = act(h + p["b_up"])
+        out = jnp.einsum("btf,fd->btd", h, maybe_dequant(p["w_down"], dt))
+        return out + p["b_down"]
     # llama-style gated SwiGLU
-    g = jnp.einsum("btd,df->btf", x, p["w_gate"])
-    u = jnp.einsum("btd,df->btf", x, p["w_up"])
+    g = jnp.einsum("btd,df->btf", x, maybe_dequant(p["w_gate"], dt))
+    u = jnp.einsum("btd,df->btf", x, maybe_dequant(p["w_up"], dt))
     h = act(g) * u
-    return jnp.einsum("btf,fd->btd", h, p["w_down"])
+    return jnp.einsum("btf,fd->btd", h, maybe_dequant(p["w_down"], dt))
 
 
 def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
@@ -234,10 +246,11 @@ def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     comb = jnp.einsum("btk,btke->bte", weights, onehot)  # [B,T,E]
 
     act = ACTIVATIONS[cfg.act]
-    g = jnp.einsum("btd,edf->ebtf", x, p["w_gate"])
-    u = jnp.einsum("btd,edf->ebtf", x, p["w_up"])
+    dt = x.dtype
+    g = jnp.einsum("btd,edf->ebtf", x, maybe_dequant(p["w_gate"], dt))
+    u = jnp.einsum("btd,edf->ebtf", x, maybe_dequant(p["w_up"], dt))
     h = act(g) * u
-    y = jnp.einsum("ebtf,efd->ebtd", h, p["w_down"])
+    y = jnp.einsum("ebtf,efd->ebtd", h, maybe_dequant(p["w_down"], dt))
     return jnp.einsum("ebtd,bte->btd", y, comb.astype(y.dtype))
 
 
@@ -263,12 +276,13 @@ def ffn_block(h: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
 def transformer_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
                       ck: jax.Array, cv: jax.Array,
                       positions: jax.Array, mask: jax.Array,
-                      cos: jax.Array, sin: jax.Array
+                      cos: jax.Array, sin: jax.Array,
+                      fresh: bool = False
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pre-norm residual block: x + attn(norm(x)); x + ffn(norm(x))."""
     h = pre_norm(x, lp["ln1"], cfg)
     attn_out, ck, cv = attention_block(h, lp["attn"], cfg, ck, cv,
-                                       positions, mask, cos, sin)
+                                       positions, mask, cos, sin, fresh)
     x = x + attn_out
     x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
     return x, ck, cv
@@ -306,7 +320,8 @@ def embed_tokens(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def scan_layers(layer_params: Params, cfg: ModelConfig, x: jax.Array,
                 k: jax.Array, v: jax.Array, positions: jax.Array,
-                mask: jax.Array, cos: jax.Array, sin: jax.Array
+                mask: jax.Array, cos: jax.Array, sin: jax.Array,
+                fresh: bool = False
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """lax.scan of transformer_layer over layer-stacked leaves.
 
@@ -318,9 +333,9 @@ def scan_layers(layer_params: Params, cfg: ModelConfig, x: jax.Array,
 
     def body(x, scanned):
         lp, ck, cv = scanned
-        lp = jax.tree.map(lambda a: a.astype(compute_dtype), lp)
+        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
         x, ck, cv = transformer_layer(x, lp, cfg, ck, cv,
-                                      positions, mask, cos, sin)
+                                      positions, mask, cos, sin, fresh)
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (layer_params, k, v))
@@ -341,16 +356,18 @@ def final_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
                             params["embed"]["tok"].astype(compute_dtype))
     else:
         logits = jnp.einsum("btd,dv->btv", x,
-                            params["lm_head"].astype(compute_dtype))
+                            maybe_dequant(params["lm_head"], compute_dtype))
     return logits.astype(jnp.float32)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
-            cache: KVCache, positions: Optional[jax.Array] = None
-            ) -> Tuple[jax.Array, KVCache]:
+            cache: KVCache, positions: Optional[jax.Array] = None,
+            fresh: bool = False) -> Tuple[jax.Array, KVCache]:
     """Run the model over `tokens` [B,T], reading/updating `cache`.
 
     positions defaults to cache.length[:,None] + arange(T) (append).
+    `fresh` (static) = the cache is empty and positions start at 0; only
+    then may the flash prefill kernel be used (see attention_block).
     Returns (logits [B,T,V] float32, updated cache).
     """
     B, T = tokens.shape
@@ -360,7 +377,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)
     x, new_k, new_v = scan_layers(params["layers"], cfg, x, cache.k, cache.v,
-                                  positions, mask, cos, sin)
+                                  positions, mask, cos, sin, fresh)
     logits = final_logits(params, cfg, x)
     new_len = cache.length + T
     return logits, KVCache(new_k, new_v, new_len)

@@ -1,7 +1,15 @@
 """Shared decode-throughput benchmark (used by bench.py and `butterfly bench`).
 
-Reports both raw tokens/sec and tokens/sec/chip (the BASELINE.json metric
-of record); one implementation so the two entrypoints can't drift.
+Reports raw tokens/sec, tokens/sec/chip (the BASELINE.json metric of
+record), and a roofline utilization estimate: decode is HBM-bandwidth
+bound (every step streams all weights + the KV cache), so
+
+    hbm_util = (param_bytes + kv_bytes) * decode_steps_per_sec / HBM_BW
+
+is the fraction of the chip's usable bandwidth the decode loop sustains.
+Decode time is isolated by subtracting a max_new=1 run (prefill + first
+sample) from the full run, so prefill cost doesn't dilute the number.
+One implementation so the entrypoints can't drift.
 """
 from __future__ import annotations
 
@@ -10,10 +18,27 @@ from typing import Dict
 
 import numpy as np
 
+# Usable HBM bandwidth per chip, bytes/sec. v5e: ~819 GB/s.
+HBM_BW = {"TPU v5 lite": 819e9, "TPU v5e": 819e9, "TPU v4": 1228e9,
+          "TPU v5p": 2765e9, "TPU v6 lite": 1640e9}
+DEFAULT_HBM_BW = 819e9
+# bf16 peak matmul throughput per chip, FLOP/s (v5e).
+PEAK_FLOPS = 197e12
+
+
+def _chip_bw() -> float:
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    for k, bw in HBM_BW.items():
+        if k.lower() in kind.lower():
+            return bw
+    return DEFAULT_HBM_BW
+
 
 def run_decode_benchmark(model, params, batch: int, prompt_len: int,
                          max_new: int, seed: int = 0) -> Dict:
     import jax
+    import jax.numpy as jnp
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine import InferenceEngine, SamplingParams
 
@@ -23,18 +48,48 @@ def run_decode_benchmark(model, params, batch: int, prompt_len: int,
     prompts = rng.randint(1, model.cfg.vocab_size,
                           (batch, prompt_len)).tolist()
     sp = SamplingParams(max_new_tokens=max_new)
+    sp1 = SamplingParams(max_new_tokens=1)
 
-    engine.generate(prompts, sp)  # compile + warmup
+    engine.generate(prompts, sp1)   # compile prefill + first sample
+    engine.generate(prompts, sp)    # compile fused decode scan
+
+    t0 = time.perf_counter()
+    engine.generate(prompts, sp1)
+    t_prefill = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     engine.generate(prompts, sp)
     dt = time.perf_counter() - t0
 
+    decode_steps = max_new - 1      # steps taken by the fused scan
+    decode_dt = max(dt - t_prefill, 1e-9)
+    steps_per_sec = decode_steps / decode_dt
+
+    # Roofline accounting: every decode step streams the full weight tree
+    # and reads the whole KV cache buffer (k + v).
+    cfg = model.cfg
+    leaves = jax.tree.leaves(engine.params)
+    param_bytes = sum(x.nbytes for x in leaves)
+    param_count = sum(x.size for x in leaves)
+    S = prompt_len + max_new
+    kv_bytes = (2 * cfg.num_layers * batch * S * cfg.num_kv_heads *
+                cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
     n_chips = max(1, len(jax.devices()))
+    bytes_per_step = param_bytes + kv_bytes
+    hbm_util = bytes_per_step * steps_per_sec / (_chip_bw() * n_chips)
+    # Decode matmul FLOPs ~= 2 * weight params * batch per step.
+    mfu = 2 * param_count * batch * steps_per_sec / (PEAK_FLOPS * n_chips)
+
     total = batch * max_new
     return {
         "tokens_per_sec": total / dt,
         "tokens_per_sec_per_chip": total / dt / n_chips,
-        "decode_seconds": dt,
+        "decode_tokens_per_sec": batch * steps_per_sec,
+        "decode_tokens_per_sec_per_chip": batch * steps_per_sec / n_chips,
+        "hbm_util": hbm_util,
+        "mfu": mfu,
+        "decode_seconds": decode_dt,
+        "prefill_seconds": t_prefill,
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": max_new,

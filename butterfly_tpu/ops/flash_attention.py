@@ -91,8 +91,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    bq = min(block_q, max(T, 8))
-    bk = min(block_k, max(T, 8))
+    # Block shapes must keep the sublane dim a multiple of 8 for Mosaic
+    # lowering on real TPU (odd T like 20 would otherwise produce 20xH
+    # blocks); padding below already handles T < block.
+    bq = min(block_q, -(-max(T, 8) // 8) * 8)
+    bk = min(block_k, -(-max(T, 8) // 8) * 8)
     Tq = -(-T // bq) * bq
     Tk = -(-T // bk) * bk
 

@@ -118,6 +118,29 @@ def _div_any(mesh: Mesh, axis: str) -> Optional[str]:
     return axis if mesh.shape[axis] > 1 else None
 
 
+def paged_cache_specs(cfg: ModelConfig, mesh: Mesh, num_slots: int):
+    """Specs for the PagedKVCache pytree (serving under a mesh).
+
+    Pool k/v_pages [L,P,page,Kv,H]: kv-heads over `tensor` (matching the
+    Megatron column-parallel wk/wv so paged writes stay local to the TP
+    shard). The page-id dim P stays replicated: page ownership is a host-
+    allocator concept and any slot may reference any page, so sharding P
+    would turn every gather into a cross-`data` collective. Slot-indexed
+    leaves (page_table [S,maxp], lengths [S]) shard slots over `data`
+    when divisible — the decode step then runs data-parallel over slots.
+    """
+    from butterfly_tpu.cache.paged import PagedKVCache
+    dslots = _div(num_slots, mesh, "data")
+    kv = P(None, None, None, _div(cfg.num_kv_heads, mesh, "tensor"), None)
+    return PagedKVCache(k_pages=kv, v_pages=kv,
+                        page_table=P(dslots, None), lengths=P(dslots))
+
+
+def shard_paged_cache(cache, cfg: ModelConfig, mesh: Mesh):
+    specs = paged_cache_specs(cfg, mesh, cache.num_slots)
+    return jax.device_put(cache, to_shardings(specs, mesh))
+
+
 def activation_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
     """[B,T,D] activations: batch over data, optionally seq over `seq`."""
     return P(_div_any(mesh, "data"), "seq" if seq_sharded and

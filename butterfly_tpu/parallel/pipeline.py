@@ -38,7 +38,8 @@ from butterfly_tpu.models.common import (
 def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
                      cache: KVCache, mesh: Mesh,
                      num_microbatches: Optional[int] = None,
-                     positions: Optional[jax.Array] = None
+                     positions: Optional[jax.Array] = None,
+                     fresh: bool = False
                      ) -> Tuple[jax.Array, KVCache]:
     """Full forward with the layer stack pipelined over `stage`.
 
@@ -53,7 +54,7 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         positions = cache.length[:, None] + jnp.arange(T)[None, :]
     if S == 1:
         from butterfly_tpu.models.common import forward
-        return forward(params, cfg, tokens, cache, positions)
+        return forward(params, cfg, tokens, cache, positions, fresh=fresh)
 
     M = num_microbatches or _default_microbatches(B, S)
     if B % M != 0:
@@ -64,7 +65,7 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)
 
-    body = partial(_pipeline_body, cfg=cfg, S=S, M=M)
+    body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh)
     # Manual over `stage` only: layer-stacked leaves and the cache split
     # their leading L dim; activations/masks are replicated over stage.
     # tensor/data stay auto (GSPMD) inside.
@@ -93,7 +94,8 @@ def _default_microbatches(B: int, S: int) -> int:
 
 
 def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
-                   *, cfg: ModelConfig, S: int, M: int):
+                   *, cfg: ModelConfig, S: int, M: int,
+                   fresh: bool = False):
     """Per-stage GPipe schedule (runs inside shard_map, manual over stage).
 
     layers/ck/cv are the local [L/S, ...] stage slice; x [B,T,D] etc. are
@@ -126,7 +128,7 @@ def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
 
         y, nk, nv = scan_layers(layers, cfg, inp, ck_m, cv_m,
                                 pos_mb[mc], mask_mb[mc], cos_mb[mc],
-                                sin_mb[mc])
+                                sin_mb[mc], fresh)
 
         # write back cache/output only on valid (non-bubble) ticks
         nk = jnp.where(valid, nk, ck_m)

@@ -1,0 +1,124 @@
+"""Int8 weight-only quantization for the bandwidth-bound decode path.
+
+Decode throughput on TPU is HBM-bound: every step streams the full weight
+tree (SURVEY.md §6; VERDICT.md round-1 roofline ~29% of v5e bandwidth).
+Symmetric per-output-channel int8 halves the streamed bytes vs bfloat16;
+XLA fuses the int8->bf16 convert + scale multiply into the matmul operand
+read, so no dequantized copy ever materializes in HBM (verified by a
+marginal-bandwidth probe on v5e).
+
+Scheme: for each matmul weight W with contraction axes C,
+    scale = absmax(W, over C) / 127        (keepdims, float32)
+    q8    = round(W / scale)               (int8)
+    W ~= q8 * scale
+Per-output-channel scales commute with the contraction, so
+`x @ (q8 * s) == (x @ q8_as_bf16) * s` — the forward dequantizes lazily
+via `maybe_dequant` and XLA folds it into the einsum.
+
+Quantized leaves are `{"q8": int8, "s": float32}` sub-dicts replacing the
+original array; everything numerically delicate (embeddings, norms,
+biases, MoE router) stays in the master dtype.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and "q8" in x and "s" in x
+
+
+def maybe_dequant(w: Any, dtype) -> jax.Array:
+    """Dequantize a `{"q8","s"}` leaf to `dtype`; pass arrays through.
+
+    The convert+multiply fuses into the consuming matmul's operand read
+    on TPU — call this directly inside the einsum expression.
+    """
+    if is_quantized_leaf(w):
+        return w["q8"].astype(dtype) * w["s"].astype(dtype)
+    return w
+
+
+def _quant(w: jax.Array, axes: Tuple[int, ...], dtype) -> Dict[str, jax.Array]:
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q8 = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    # Scale lives in the compute dtype so engine cast_params is a no-op
+    # on a quantized tree (no donating cast; the tree stays reusable).
+    return {"q8": q8, "s": scale.astype(dtype)}
+
+
+def quantize_int8(params: Params, cfg) -> Params:
+    """Quantize every matmul weight of an init_params-shaped tree.
+
+    Contraction axes per leaf (leading L = stacked layers):
+      wq/wk/wv [L,D,N,H] -> D;  wo [L,N,H,D] -> (N,H)
+      mlp w_gate/w_up [L,D,F] -> D;  w_down [L,F,D] -> F
+      moe w_* [L,E,D,F] / [L,E,F,D] -> the D/F contraction axis
+      lm_head [D,V] -> D
+    Runs as one jit so a large tree quantizes device-side in one program.
+    """
+
+    dt = jnp.dtype(cfg.dtype)
+
+    @jax.jit
+    def go(params):
+        layers = dict(params["layers"])
+        attn = dict(layers["attn"])
+        for k in ("wq", "wk", "wv"):
+            attn[k] = _quant(attn[k], (1,), dt)
+        attn["wo"] = _quant(attn["wo"], (1, 2), dt)
+        layers["attn"] = attn
+        if "mlp" in layers:
+            mlp = dict(layers["mlp"])
+            for k in ("w_gate", "w_up"):
+                if k in mlp:
+                    mlp[k] = _quant(mlp[k], (1,), dt)
+            mlp["w_down"] = _quant(mlp["w_down"], (1,), dt)
+            layers["mlp"] = mlp
+        if "moe" in layers:
+            moe = dict(layers["moe"])
+            for k in ("w_gate", "w_up", "w_down"):
+                moe[k] = _quant(moe[k], (2,), dt)
+            layers["moe"] = moe
+        out = dict(params)
+        out["layers"] = layers
+        if "lm_head" in params:
+            out["lm_head"] = _quant(params["lm_head"], (0,), dt)
+        return out
+
+    return go(params)
+
+
+def quant_specs_like(qparams: Params, specs: Params) -> Params:
+    """Mirror a param_specs tree onto a quantized tree.
+
+    The weight's PartitionSpec applies to q8 unchanged; the scale keeps
+    the spec only on dims that are still >1 (contraction dims collapsed
+    to 1 by keepdims must not be sharded).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def rec(qp, sp):
+        if is_quantized_leaf(qp):
+            s_spec = P(*[sp[i] if qp["s"].shape[i] > 1 else None
+                         for i in range(len(qp["s"].shape))])
+            return {"q8": sp, "s": s_spec}
+        if isinstance(qp, dict):
+            return {k: rec(qp[k], sp[k]) for k in qp}
+        return sp
+
+    return rec(qparams, specs)
+
+
+def shard_quantized_params(qparams: Params, cfg, mesh) -> Params:
+    """device_put a quantized tree to its partitioned layout (TP etc.)."""
+    from butterfly_tpu.parallel.partition import param_specs, to_shardings
+    specs = quant_specs_like(qparams, param_specs(cfg, mesh))
+    return jax.device_put(qparams, to_shardings(specs, mesh))

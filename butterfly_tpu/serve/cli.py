@@ -32,6 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--tensor-parallel", type=int, default=1)
         sp.add_argument("--stage-parallel", type=int, default=1)
         sp.add_argument("--expert-parallel", type=int, default=1)
+        sp.add_argument("--data-parallel", type=int, default=1)
         sp.add_argument("--max-seq", type=int, default=2048)
 
     g = sub.add_parser("generate", help="one-shot text generation")
@@ -82,6 +83,42 @@ def load_params(model, args):
     return model.init(jax.random.PRNGKey(0))
 
 
+def build_mesh(args):
+    """Mesh from the CLI parallelism flags; None when all are 1.
+
+    Multi-host: call with BUTTERFLY_NUM_PROCESSES set and the coordinator
+    flags in the environment — init_distributed runs first so
+    jax.devices() spans every host (core/mesh.py).
+    """
+    import jax
+    from butterfly_tpu.core.config import MeshConfig
+    from butterfly_tpu.core.mesh import init_distributed, make_mesh
+
+    tp = getattr(args, "tensor_parallel", 1)
+    pp = getattr(args, "stage_parallel", 1)
+    ep = getattr(args, "expert_parallel", 1)
+    dp = getattr(args, "data_parallel", 1)
+    n = tp * pp * ep * dp
+    if n == 1:
+        return None
+    init_distributed()
+    ndev = len(jax.devices())
+    if n > ndev:
+        raise SystemExit(
+            f"error: --tensor-parallel {tp} x --stage-parallel {pp} x "
+            f"--expert-parallel {ep} x --data-parallel {dp} = {n} devices, "
+            f"but only {ndev} are available")
+    cfg = MeshConfig(data=dp, stage=pp, expert=ep, tensor=tp)
+    return make_mesh(cfg, jax.devices()[:n])
+
+
+def shard_for_mesh(params, cfg, mesh):
+    if mesh is None:
+        return params
+    from butterfly_tpu.parallel.partition import shard_params
+    return shard_params(params, cfg, mesh)
+
+
 def cmd_generate(args) -> int:
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine import InferenceEngine, SamplingParams
@@ -89,9 +126,11 @@ def cmd_generate(args) -> int:
 
     model = resolve_model(args)
     tok = load_tokenizer(args.tokenizer or args.ckpt)
-    params = load_params(model, args)
+    mesh = build_mesh(args)
+    params = shard_for_mesh(load_params(model, args), model.cfg, mesh)
     engine = InferenceEngine(model, params,
-                             runtime=RuntimeConfig(max_seq_len=args.max_seq))
+                             runtime=RuntimeConfig(max_seq_len=args.max_seq),
+                             mesh=mesh)
     vocab = model.cfg.vocab_size
     stop = tok.eos_id if tok.eos_id is not None and tok.eos_id < vocab else -1
     sp = SamplingParams(temperature=args.temperature, top_k=args.top_k,

@@ -268,20 +268,23 @@ def run_server(args) -> int:
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine.serving import ServingEngine
     from butterfly_tpu.sched.scheduler import Scheduler
-    from butterfly_tpu.serve.cli import load_params, resolve_model
+    from butterfly_tpu.serve.cli import build_mesh, load_params, resolve_model
     from butterfly_tpu.utils.tokenizer import load_tokenizer
 
     model = resolve_model(args)
     tok = load_tokenizer(args.tokenizer or args.ckpt)
+    mesh = build_mesh(args)
     params = load_params(model, args)
     rt = RuntimeConfig(max_batch_size=args.max_batch,
                        max_seq_len=args.max_seq, page_size=args.page_size,
                        top_k=args.top_k, top_p=args.top_p,
                        max_queue=args.max_queue)
-    engine = ServingEngine(model, params, rt)
+    engine = ServingEngine(model, params, rt, mesh=mesh)
     sched = Scheduler(engine)
+    mesh_desc = "" if mesh is None else \
+        " mesh=" + "x".join(f"{k}{v}" for k, v in mesh.shape.items() if v > 1)
     print(f"[butterfly] serving {args.model} on {args.host}:{args.port} "
           f"(slots={rt.max_batch_size}, pages={engine.cache.num_pages - 1}"
-          f"x{rt.page_size}tok)", flush=True)
+          f"x{rt.page_size}tok{mesh_desc})", flush=True)
     return serve_forever(sched, tok, args.host, args.port,
                          max_queue=rt.max_queue)
