@@ -161,17 +161,23 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
         q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
         kp, vp = write_paged_layer(kp, vp, cache.page_table, k, v, start,
                                    active)
+        out = None
         if use_kernel and T == 1:
-            from butterfly_tpu.ops.paged_attention import paged_attention
+            from butterfly_tpu.ops.paged_attention import (
+                paged_attention_sharded)
             # lengths INCLUDING the token just written (inactive: 0 -> no
             # pages visited, output discarded)
             lens = jnp.where(active, positions[:, 0] + 1, 0)
-            out = paged_attention(q[:, 0], kp, vp, cache.page_table,
-                                  lens)[:, None]
+            out = paged_attention_sharded(q[:, 0], kp, vp,
+                                          cache.page_table, lens)
+            out = out[:, None] if out is not None else None
         elif cfg.attn_impl == "flash" and T > 1 and fresh:
-            from butterfly_tpu.ops.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=True)
-        else:
+            from butterfly_tpu.ops.flash_attention import (
+                flash_attention_sharded)
+            out = flash_attention_sharded(q, k, v, causal=True)
+        if out is None:
+            # no mesh axis can shard the kernel operands (or kernels off):
+            # dense gather attention, which GSPMD partitions itself.
             ck = gather_paged_layer(kp, cache.page_table)
             cv = gather_paged_layer(vp, cache.page_table)
             out = attend(q, ck, cv, mask, cfg)

@@ -55,13 +55,10 @@ class InferenceEngine:
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
         if use_flash_prefill is None:
-            # Pallas kernels: TPU-only, and only unmeshed — inside an
-            # auto-partitioned jit a pallas_call is an opaque custom call
-            # GSPMD can't shard (wrap in shard_map before enabling there).
-            use_flash_prefill = (jax.default_backend() == "tpu"
-                                 and (mesh is None
-                                      or all(s == 1 for s in
-                                             mesh.shape.values())))
+            # Pallas kernels are TPU-only; under a mesh the call sites go
+            # through ops/*_sharded (shard_map over data/tensor), so a
+            # mesh no longer disables them.
+            use_flash_prefill = jax.default_backend() == "tpu"
 
         # One forward callable per step kind: the plain single-program
         # forward, or the GPipe pipeline when the mesh has stage > 1.

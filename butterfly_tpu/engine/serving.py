@@ -72,13 +72,10 @@ class ServingEngine:
                 "decode path scans the full layer stack; use tensor/data "
                 "axes (pipeline serving tracked for a later round)")
         if use_kernels is None:
-            # Pallas kernels: TPU-only, and only unmeshed (a pallas_call
-            # inside an auto-partitioned jit is an opaque custom call
-            # GSPMD can't shard — wrap in shard_map before enabling).
-            use_kernels = (jax.default_backend() == "tpu"
-                           and (mesh is None
-                                or all(s == 1 for s in
-                                       mesh.shape.values())))
+            # Pallas kernels are TPU-only; under a mesh the call sites go
+            # through ops/*_sharded (shard_map over data/tensor), so a
+            # mesh no longer disables them.
+            use_kernels = jax.default_backend() == "tpu"
         self.cache = init_paged_cache(self.cfg, self.runtime)
         if mesh is not None:
             # Megatron param layout + paged pool sharded to match (kv

@@ -209,10 +209,12 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
     q, k, v = qkv_proj(x, p, cfg, cos, sin)
     start = positions[:, 0]  # write offset per sequence
     ck, cv = update_cache_layer(ck, cv, k, v, start)
+    out = None
     if cfg.attn_impl == "flash" and x.shape[1] > 1 and fresh:
-        from butterfly_tpu.ops.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal=True)
-    else:
+        from butterfly_tpu.ops.flash_attention import flash_attention_sharded
+        # None = no mesh axis can shard the kernel operands; use dense.
+        out = flash_attention_sharded(q, k, v, causal=True)
+    if out is None:
         out = attend(q, ck, cv, mask, cfg)
     return attn_output(out, p, cfg), ck, cv
 

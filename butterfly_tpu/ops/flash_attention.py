@@ -74,6 +74,78 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
                        jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
+def _auto_axes(mesh) -> set:
+    """Axis names of the ambient mesh still under GSPMD (Auto) control."""
+    from jax.sharding import AxisType
+    return {n for n, t in zip(mesh.axis_names, mesh.axis_types)
+            if t == AxisType.Auto}
+
+
+def shardable_axes(batch: int, nq: int, kv: int):
+    """(data_axis, tensor_axis) of the ambient mesh usable to shard an
+    attention operand set: `data` must divide the batch/slot dim, `tensor`
+    must divide both head counts; an axis is skipped when absent, size 1,
+    or already Manual from an enclosing shard_map (e.g. the pipeline's
+    `stage`). Shared eligibility rule for both kernel wrappers."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return None, None
+    auto = _auto_axes(mesh)
+    d = "data" if ("data" in auto and mesh.shape["data"] > 1
+                   and batch % mesh.shape["data"] == 0) else None
+    t = "tensor" if ("tensor" in auto and mesh.shape["tensor"] > 1
+                     and nq % mesh.shape["tensor"] == 0
+                     and kv % mesh.shape["tensor"] == 0) else None
+    return d, t
+
+
+def live_auto_mesh() -> bool:
+    """True when the ambient mesh has any multi-device axis still under
+    GSPMD (Auto) control — a bare pallas_call traced there would be an
+    opaque custom call the partitioner can't shard."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return False
+    return any(mesh.shape[n] > 1 for n in _auto_axes(mesh))
+
+
+def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                            causal: bool = True) -> jax.Array:
+    """Mesh-aware flash attention (SURVEY.md §7 stages 4/6).
+
+    A pallas_call is an opaque custom call GSPMD cannot partition, so under
+    an active mesh we wrap the kernel in `shard_map` over the axes whose
+    sharding the partitioner gave these operands: batch over `data`, heads
+    over `tensor` (parallel/partition.py puts q-heads/kv-heads there via
+    the column-parallel wq/wk/wv). Attention is purely local to a
+    (batch, head) shard — each shard runs the unmodified kernel on its
+    slice, no collectives. Axes that don't divide (or are already Manual
+    from an enclosing shard_map, e.g. the pipeline's `stage`) are left
+    alone; with no mesh at all this is exactly `flash_attention`.
+
+    Returns None when a live multi-device Auto mesh is present but no
+    axis can shard the operands: the caller MUST fall back to its dense
+    path there (a bare pallas_call under GSPMD is an opaque custom call
+    — the failure mode the engines' old mesh-disables-kernels guard
+    existed to prevent).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    B, T, Nq, H = q.shape
+    Kv = k.shape[2]
+    d, t = shardable_axes(B, Nq, Kv)
+    if d is None and t is None:
+        if live_auto_mesh():
+            return None
+        return flash_attention(q, k, v, causal=causal)
+    spec = P(d, None, t, None)
+    fn = jax.shard_map(
+        functools.partial(flash_attention, causal=causal),
+        in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={a for a in (d, t) if a is not None}, check_vma=False)
+    return fn(q, k, v)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -86,6 +86,43 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                     jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
+def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, page_table: jax.Array,
+                            lengths: jax.Array) -> jax.Array:
+    """Mesh-aware paged attention for meshed serving (SURVEY.md §7 stage 6).
+
+    shard_map over the axes the paged partitioner uses
+    (parallel/partition.py paged_cache_specs): slots over `data`, q/kv
+    heads over `tensor`; the page-id dim stays replicated (any slot may
+    reference any page). Each shard walks its own slots' block tables with
+    the unmodified kernel — purely local, no collectives.
+
+    Returns None when a live multi-device Auto mesh is present but no
+    axis can shard the operands — the caller must use the gather path
+    (see flash_attention_sharded for the opaque-custom-call rationale);
+    with no mesh at all this is exactly `paged_attention`.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from butterfly_tpu.ops.flash_attention import (live_auto_mesh,
+                                                   shardable_axes)
+
+    S, Nq, H = q.shape
+    Kv = k_pages.shape[2]
+    d, t = shardable_axes(S, Nq, Kv)
+    if d is None and t is None:
+        if live_auto_mesh():
+            return None
+        return paged_attention(q, k_pages, v_pages, page_table, lengths)
+    kv_spec = P(None, None, t, None)
+    fn = jax.shard_map(
+        paged_attention,
+        in_specs=(P(d, t, None), kv_spec, kv_spec, P(d, None), P(d)),
+        out_specs=P(d, t, None),
+        axis_names={a for a in (d, t) if a is not None}, check_vma=False)
+    return fn(q, k_pages, v_pages, page_table, lengths)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, lengths: jax.Array,

@@ -58,6 +58,33 @@ def test_meshed_scheduler_token_parity(params, mesh):
     assert not bad, f"meshed serving donation failed to alias: {bad}"
 
 
+def test_meshed_scheduler_kernels_token_parity(params, mesh):
+    """Pallas kernels (interpret mode) under the mesh == unmeshed gather
+    path, token-exact — the round-2 VERDICT item 1 regression test."""
+    ref = _make_sched(params)
+    ref_reqs = [ref.submit(p, max_new_tokens=6) for p in PROMPTS]
+    ref.run_until_done()
+
+    rt = RuntimeConfig(max_batch_size=4, max_seq_len=64, page_size=8)
+    sched = Scheduler(ServingEngine(Model(CFG), params, rt, mesh=mesh,
+                                    use_kernels=True))
+    reqs = [sched.submit(p, max_new_tokens=6) for p in PROMPTS]
+    sched.run_until_done()
+    assert [r.output for r in reqs] == [r.output for r in ref_reqs]
+
+
+def test_meshed_engine_flash_prefill_token_parity(params, mesh):
+    """InferenceEngine flash prefill through shard_map on the mesh."""
+    import numpy as np
+    from butterfly_tpu.engine import InferenceEngine, SamplingParams
+    sp = SamplingParams(max_new_tokens=6)
+    a = InferenceEngine(Model(CFG), params,
+                        use_flash_prefill=False).generate(PROMPTS, sp)
+    b = InferenceEngine(Model(CFG), params, mesh=mesh,
+                        use_flash_prefill=True).generate(PROMPTS, sp)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
 def test_meshed_pool_is_sharded(params, mesh):
     eng = ServingEngine(Model(CFG), params,
                         RuntimeConfig(max_batch_size=4, max_seq_len=64,
