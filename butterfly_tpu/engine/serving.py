@@ -81,9 +81,17 @@ class ServingEngine:
             # Megatron param layout + paged pool sharded to match (kv
             # heads over `tensor`, slots over `data`): prefill/decode
             # below then compile to one SPMD program over the mesh.
+            # Quantized trees route through the quant-aware specs (the
+            # float specs would shard a scale's size-1 contraction dim).
             from butterfly_tpu.parallel.partition import (
                 shard_paged_cache, shard_params)
-            self.params = shard_params(self.params, self.cfg, mesh)
+            from butterfly_tpu.quant.int8 import (
+                shard_quantized_params, tree_is_quantized)
+            if tree_is_quantized(self.params):
+                self.params = shard_quantized_params(self.params, self.cfg,
+                                                     mesh)
+            else:
+                self.params = shard_params(self.params, self.cfg, mesh)
             self.cache = shard_paged_cache(self.cache, self.cfg, mesh)
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
             if use_kernels else self.cfg

@@ -4,46 +4,53 @@ Reports raw tokens/sec, tokens/sec/chip (the BASELINE.json metric of
 record), and a roofline utilization estimate: decode is HBM-bandwidth
 bound (every step streams all weights + the KV cache), so
 
-    hbm_util = (param_bytes + kv_bytes) * decode_steps_per_sec / HBM_BW
+    hbm_util = bytes_streamed_per_step * decode_steps_per_sec / HBM_BW
 
-is the fraction of the chip's usable bandwidth the decode loop sustains.
-Decode time is isolated by subtracting a max_new=1 run (prefill + first
-sample) from the full run, so prefill cost doesn't dilute the number.
-One implementation so the entrypoints can't drift.
+is the fraction of the chips' usable bandwidth the decode loop sustains.
+Weights replicated over the `data` mesh axis are streamed once *per
+replica* (each chip reads its own copy), so bytes_per_step scales with
+the data-parallel degree. Decode time is isolated by subtracting a
+max_new=1 run (prefill + first sample) from the full run, so prefill
+cost doesn't dilute the number. One implementation so the entrypoints
+can't drift.
 """
 from __future__ import annotations
 
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
 # Usable HBM bandwidth per chip, bytes/sec. v5e: ~819 GB/s.
 HBM_BW = {"TPU v5 lite": 819e9, "TPU v5e": 819e9, "TPU v4": 1228e9,
-          "TPU v5p": 2765e9, "TPU v6 lite": 1640e9}
+          "TPU v5p": 2765e9, "TPU v6 lite": 1640e9, "TPU v6e": 1640e9}
 DEFAULT_HBM_BW = 819e9
-# bf16 peak matmul throughput per chip, FLOP/s (v5e).
-PEAK_FLOPS = 197e12
+# bf16 dense peak matmul throughput per chip, FLOP/s, per device kind.
+PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+              "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12}
+DEFAULT_PEAK_FLOPS = 197e12
 
 
-def _chip_bw() -> float:
+def _chip_lookup(table: Dict[str, float], default: float) -> float:
     import jax
     kind = getattr(jax.devices()[0], "device_kind", "")
-    for k, bw in HBM_BW.items():
+    for k, v in table.items():
         if k.lower() in kind.lower():
-            return bw
-    return DEFAULT_HBM_BW
+            return v
+    return default
 
 
 def run_decode_benchmark(model, params, batch: int, prompt_len: int,
-                         max_new: int, seed: int = 0) -> Dict:
+                         max_new: int, seed: int = 0,
+                         mesh=None) -> Dict:
     import jax
     import jax.numpy as jnp
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine import InferenceEngine, SamplingParams
 
     engine = InferenceEngine(
-        model, params, RuntimeConfig(max_seq_len=prompt_len + max_new))
+        model, params, RuntimeConfig(max_seq_len=prompt_len + max_new),
+        mesh=mesh)
     rng = np.random.RandomState(seed)
     prompts = rng.randint(1, model.cfg.vocab_size,
                           (batch, prompt_len)).tolist()
@@ -66,7 +73,10 @@ def run_decode_benchmark(model, params, batch: int, prompt_len: int,
     steps_per_sec = decode_steps / decode_dt
 
     # Roofline accounting: every decode step streams the full weight tree
-    # and reads the whole KV cache buffer (k + v).
+    # and reads the whole KV cache buffer (k + v). An unmeshed engine runs
+    # on exactly one chip regardless of how many the host exposes; a
+    # meshed engine uses mesh.size chips and streams one weight copy per
+    # data-parallel replica.
     cfg = model.cfg
     leaves = jax.tree.leaves(engine.params)
     param_bytes = sum(x.nbytes for x in leaves)
@@ -74,11 +84,14 @@ def run_decode_benchmark(model, params, batch: int, prompt_len: int,
     S = prompt_len + max_new
     kv_bytes = (2 * cfg.num_layers * batch * S * cfg.num_kv_heads *
                 cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
-    n_chips = max(1, len(jax.devices()))
-    bytes_per_step = param_bytes + kv_bytes
-    hbm_util = bytes_per_step * steps_per_sec / (_chip_bw() * n_chips)
+    n_chips = mesh.size if mesh is not None else 1
+    dp = mesh.shape.get("data", 1) if mesh is not None else 1
+    bytes_per_step = param_bytes * dp + kv_bytes
+    hbm_util = (bytes_per_step * steps_per_sec /
+                (_chip_lookup(HBM_BW, DEFAULT_HBM_BW) * n_chips))
     # Decode matmul FLOPs ~= 2 * weight params * batch per step.
-    mfu = 2 * param_count * batch * steps_per_sec / (PEAK_FLOPS * n_chips)
+    mfu = (2 * param_count * batch * steps_per_sec /
+           (_chip_lookup(PEAK_FLOPS, DEFAULT_PEAK_FLOPS) * n_chips))
 
     total = batch * max_new
     return {

@@ -1,2 +1,3 @@
 from butterfly_tpu.quant.int8 import (  # noqa: F401
-    maybe_dequant, quant_specs_like, quantize_int8, shard_quantized_params)
+    maybe_dequant, quant_specs_like, quantize_int8, shard_quantized_params,
+    tree_is_quantized)

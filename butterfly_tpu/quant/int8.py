@@ -33,6 +33,14 @@ def is_quantized_leaf(x: Any) -> bool:
     return isinstance(x, dict) and "q8" in x and "s" in x
 
 
+def tree_is_quantized(params: Params) -> bool:
+    """True if any leaf of the pytree is a `{"q8","s"}` quantized dict."""
+    found = []
+    jax.tree.map(lambda x: found.append(True) if is_quantized_leaf(x)
+                 else None, params, is_leaf=is_quantized_leaf)
+    return bool(found)
+
+
 def maybe_dequant(w: Any, dtype) -> jax.Array:
     """Dequantize a `{"q8","s"}` leaf to `dtype`; pass arrays through.
 
