@@ -13,8 +13,9 @@ recompile (SURVEY.md §7 "hard parts"):
   the null page). Sampling is vectorized with per-slot temperature so
   requests with different sampling settings batch together.
 
-Parity contract: tests/test_serving.py checks token-for-token equality
-with InferenceEngine.generate on the contiguous cache.
+Parity contract: tests/test_sched.py and tests/test_serving_mesh.py check
+token-for-token equality with InferenceEngine.generate on the contiguous
+cache (single-device and meshed respectively).
 """
 from __future__ import annotations
 

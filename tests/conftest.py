@@ -18,6 +18,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Sanitizer mode (SURVEY.md §5 race-detection row): BUTTERFLY_DEBUG_NANS=1
+# makes every jitted program re-run op-by-op on the first NaN and raise,
+# turning silent numeric corruption into a test failure. Off by default
+# because it disables donation and slows the suite.
+if os.environ.get("BUTTERFLY_DEBUG_NANS") == "1":
+    jax.config.update("jax_debug_nans", True)
+
 import pytest  # noqa: E402
 
 
