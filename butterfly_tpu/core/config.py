@@ -177,12 +177,14 @@ class RuntimeConfig:
 
     max_batch_size: int = 8
     max_seq_len: int = 2048
-    prefill_chunk: int = 512          # chunked prefill unit
+    prefill_chunk: int = 512          # max prefill tokens per scheduler tick;
+                                      # long prompts continue across ticks
     page_size: int = 16               # paged-KV tokens per block
     num_pages: int = 0                # 0 => derive from max_batch/max_seq
-    scheduler: str = "continuous"     # "continuous" | "static"
+    scheduler: str = "continuous"     # "continuous" (chunked-prefill/decode
+                                      # interleave) | "static" (drain batches)
     max_queue: int = 256
-    decode_steps_per_tick: int = 1
+    decode_steps_per_tick: int = 1    # decode steps run per tick()
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

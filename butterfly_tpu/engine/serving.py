@@ -96,8 +96,12 @@ class ServingEngine:
             self.cache = shard_paged_cache(self.cache, self.cfg, mesh)
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
             if use_kernels else self.cfg
+        # Two prefill programs: fresh (start==0, may take the flash kernel)
+        # and warm (chunk continuation — attends through the cache, dense).
         self._prefill = jax.jit(
-            partial(_prefill_slot, prefill_cfg), donate_argnums=(2, 3))
+            partial(_prefill_slot, prefill_cfg, True), donate_argnums=(2, 3))
+        self._prefill_warm = jax.jit(
+            partial(_prefill_slot, self.cfg, False), donate_argnums=(2, 3))
         self._decode = jax.jit(
             partial(_decode_all, self.cfg, use_kernel=use_kernels),
             static_argnums=(5, 6), donate_argnums=(2,))
@@ -129,18 +133,28 @@ class ServingEngine:
                 lengths=self.cache.lengths.at[slot].set(0))
 
     def prefill_slot(self, slot: int, prompt: list[int]) -> jax.Array:
-        """Run one request's prompt; returns last-token logits [V]."""
-        T = bucket_len(len(prompt))
-        tokens = np.zeros((1, T), np.int32)
-        tokens[0, :len(prompt)] = prompt
+        """Run one request's whole prompt; returns last-token logits [V]."""
+        return self.prefill_chunk(slot, prompt, 0)
+
+    def prefill_chunk(self, slot: int, tokens: list[int],
+                      start: int) -> jax.Array:
+        """Run one chunk of a request's prompt (absolute positions
+        start..start+len-1) against the slot's pages; returns the chunk's
+        last-token logits [V]. start==0 is a fresh prefill (flash-kernel
+        eligible); start>0 continues a warm cache through the dense path."""
+        T = bucket_len(len(tokens))
+        buf = np.zeros((1, T), np.int32)
+        buf[0, :len(tokens)] = tokens
+        prog = self._prefill if start == 0 else self._prefill_warm
         with self._mesh_ctx():
-            logits, k_pages, v_pages = self._prefill(
-                self.params, jnp.asarray(tokens), self.cache.k_pages,
+            logits, k_pages, v_pages = prog(
+                self.params, jnp.asarray(buf), self.cache.k_pages,
                 self.cache.v_pages, self.cache.page_table[slot][None],
-                jnp.asarray([len(prompt)], jnp.int32))
+                jnp.asarray([len(tokens)], jnp.int32),
+                jnp.asarray([start], jnp.int32))
             self.cache = self.cache._replace(
                 k_pages=k_pages, v_pages=v_pages,
-                lengths=self.cache.lengths.at[slot].set(len(prompt)))
+                lengths=self.cache.lengths.at[slot].set(start + len(tokens)))
         return logits[0]
 
     def decode_active(self, tokens: np.ndarray, active: np.ndarray,
@@ -165,17 +179,20 @@ class ServingEngine:
         return self.runtime.top_p
 
 
-def _prefill_slot(cfg: ModelConfig, params, tokens, k_pages, v_pages,
-                  table_row, true_len):
-    """[1,T] prompt against the slot's table row; pool-wide scatter."""
-    from butterfly_tpu.models.common import (
-        embed_tokens, final_logits, make_mask)
+def _prefill_slot(cfg: ModelConfig, fresh: bool, params, tokens, k_pages,
+                  v_pages, table_row, true_len, start):
+    """[1,T] prompt chunk against the slot's table row; pool-wide scatter.
+
+    `start` [1] is the chunk's first absolute position; `fresh` (static)
+    means start==0 and the slot's pages are empty (flash-path eligible).
+    """
     cache1 = PagedKVCache(k_pages, v_pages, table_row,
                           jnp.zeros((1,), jnp.int32))
     B, T = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    positions = start[:, None] + jnp.broadcast_to(jnp.arange(T)[None, :],
+                                                  (B, T))
     logits, cache1 = paged_forward(params, cfg, tokens, cache1, positions,
-                                   fresh=True)
+                                   fresh=fresh)
     last = jnp.take_along_axis(logits, (true_len - 1)[:, None, None], axis=1)
     return last[:, 0, :], cache1.k_pages, cache1.v_pages
 

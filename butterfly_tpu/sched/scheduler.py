@@ -8,8 +8,14 @@ BASELINE.json configs[4] serving shape.
 Host-side policy over the static-shape device programs in
 engine/serving.py:
 
-* tick() = [admit waiting requests into free slots + prefill each] then
-  [one batched decode step for all active slots].
+* tick() = [≤ prefill_chunk tokens of (chunked) prefill work] then
+  [decode_steps_per_tick batched decode steps for all active slots].
+  Long prompts are split into prefill_chunk-sized pieces that continue
+  the warm cache across ticks, so a max-length admission can never
+  head-of-line-block decoding requests for more than one chunk.
+* scheduler="static" disables interleaving: a whole batch is admitted
+  (full prompts at once) only when the previous batch has fully drained —
+  the classic throughput-oriented static-batching mode.
 * Admission allocates pages for prompt+1; each decode step grows a slot's
   pages just-in-time. If the pool is exhausted, the youngest running
   request is PREEMPTED (pages freed, request requeued; its prompt +
@@ -44,7 +50,8 @@ class Request:
     # runtime state
     output: List[int] = field(default_factory=list)
     slot: Optional[int] = None
-    state: str = "waiting"  # waiting | running | finished | cancelled
+    state: str = "waiting"  # waiting | prefilling | running | finished | cancelled
+    prefilled: int = 0      # prompt tokens already in the KV cache
     preemptions: int = 0
     t_arrive: float = field(default_factory=time.monotonic)
     t_first_token: Optional[float] = None
@@ -74,11 +81,15 @@ class Scheduler:
     def __init__(self, engine: ServingEngine, seed: int = 0):
         self.engine = engine
         rt = engine.runtime
+        if rt.scheduler not in ("continuous", "static"):
+            raise ValueError(f"unknown scheduler {rt.scheduler!r}: "
+                             "expected 'continuous' or 'static'")
         max_pages = engine.cache.page_table.shape[1]
         self.alloc = PageAllocator(engine.cache.num_pages - 1,
                                    engine.cache.page_size, max_pages)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
+        self._prefilling: Optional[Request] = None  # mid-chunked-prefill
         self.slots: List[Optional[Request]] = [None] * engine.num_slots
         self._ids = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -118,11 +129,18 @@ class Scheduler:
             self.waiting.remove(req)
         self._finish(req, state="cancelled")
 
+    @property
+    def _all_live(self) -> List[Request]:
+        live = list(self.running)
+        if self._prefilling is not None:
+            live.append(self._prefilling)
+        return live
+
     def abort_all(self) -> None:
         """Wedge-path drain: host-only bookkeeping, NO device calls (the
         device may be the thing that's broken). Every waiter's on_finish
         fires; slots/pages are reclaimed in host state only."""
-        for req in list(self.running) + list(self.waiting):
+        for req in self._all_live + list(self.waiting):
             req.state = "cancelled"
             req.t_finish = time.monotonic()
             if req.slot is not None:
@@ -136,10 +154,12 @@ class Scheduler:
                     pass
         self.running.clear()
         self.waiting.clear()
+        self._prefilling = None
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running
+                    or self._prefilling is not None)
 
     def run_until_done(self, max_ticks: int = 100000) -> None:
         for _ in range(max_ticks):
@@ -149,20 +169,24 @@ class Scheduler:
         raise RuntimeError("scheduler did not drain")
 
     def tick(self) -> int:
-        """One scheduling round: admit, then one decode step.
+        """One scheduling round: bounded prefill work, then decode step(s).
 
-        Returns the number of tokens generated this round (throughput
-        accounting for the serve loop)."""
+        Continuous mode interleaves at most `prefill_chunk` prompt tokens
+        of (possibly partial) prefill with `decode_steps_per_tick` decode
+        steps, bounding every decoding request's inter-token gap under
+        admission pressure. Returns the number of tokens generated this
+        round (throughput accounting for the serve loop)."""
         before = self._metrics["tokens_generated_total"]
         self._admit()
-        if self.running:
-            self._decode_step()
+        for _ in range(max(1, self.engine.runtime.decode_steps_per_tick)):
+            if self.running:
+                self._decode_step()
         return int(self._metrics["tokens_generated_total"] - before)
 
     def metrics(self) -> Dict[str, float]:
         m = dict(self._metrics)
         m["queue_depth"] = len(self.waiting)
-        m["active_requests"] = len(self.running)
+        m["active_requests"] = len(self._all_live)
         m["kv_pages_free"] = self.alloc.free_pages
         m["kv_pages_total"] = self.alloc.num_pages
         if self._ttfts:
@@ -180,28 +204,58 @@ class Scheduler:
         return None
 
     def _admit(self) -> None:
-        while self.waiting:
-            slot = self._free_slot()
-            if slot is None:
+        rt = self.engine.runtime
+        if rt.scheduler == "static":
+            # Static batching: no interleave — admit (and fully prefill) a
+            # whole batch only once the previous batch has drained.
+            if self.running or self._prefilling is not None:
                 return
-            req = self.waiting[0]
-            prefix = req.all_tokens  # includes output if preempted earlier
-            if self.alloc.grow(slot, len(prefix) + 1) is None:
-                return  # pool exhausted; decode will free/preempt
-            self.waiting.popleft()
-            req.slot, req.state = slot, "running"
-            self.slots[slot] = req
-            self.running.append(req)
+            budget = None  # unbounded: whole prompts at once
+        else:
+            budget = max(1, rt.prefill_chunk)
 
-            self.engine.set_table_row(slot, self.alloc.pages_of(slot))
-            logits = self.engine.prefill_slot(slot, prefix)
+        while budget is None or budget > 0:
+            if self._prefilling is None:
+                if not self.waiting:
+                    return
+                slot = self._free_slot()
+                if slot is None:
+                    return
+                req = self.waiting[0]
+                # includes output if preempted earlier
+                if self.alloc.grow(slot, len(req.all_tokens) + 1) is None:
+                    return  # pool exhausted; decode will free/preempt
+                self.waiting.popleft()
+                req.slot, req.state = slot, "prefilling"
+                req.prefilled = 0
+                self.slots[slot] = req
+                self._prefilling = req
+                self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+
+            req = self._prefilling
+            prefix = req.all_tokens
+            end = len(prefix) if budget is None \
+                else min(len(prefix), req.prefilled + budget)
+            chunk = prefix[req.prefilled:end]
+            logits = self.engine.prefill_chunk(req.slot, chunk, req.prefilled)
+            req.prefilled = end
+            if budget is not None:
+                budget -= len(chunk)
+            if req.prefilled < len(prefix):
+                return  # chunk budget spent; continue next tick
+
+            # prompt fully in cache: sample the first token, start decoding
+            self._prefilling = None
+            req.state = "running"
+            self.running.append(req)
             self._key, sub = jax.random.split(self._key)
             first = sample_batched(
                 logits[None], sub,
                 np.asarray([req.temperature], np.float32),
                 self.engine.runtime_top_k, self.engine.runtime_top_p)
             self._emit(req, int(first[0]))
-            self._next_tokens[slot] = int(first[0])
+            if req.slot is not None:  # may have finished on max_new==1
+                self._next_tokens[req.slot] = int(first[0])
 
     def _decode_step(self) -> None:
         # just-in-time page growth (may preempt the youngest requests)
@@ -241,6 +295,8 @@ class Scheduler:
     def _finish(self, req: Request, state: str = "finished") -> None:
         req.state = state
         req.t_finish = time.monotonic()
+        if self._prefilling is req:  # cancelled mid-chunked-prefill
+            self._prefilling = None
         if req.slot is not None:
             self.alloc.release(req.slot)
             self.engine.reset_slot(req.slot)
@@ -279,4 +335,5 @@ class Scheduler:
         self.running.remove(req)
         # all_tokens (prompt + output) are recomputed on readmission
         req.state = "waiting"
+        req.prefilled = 0
         self.waiting.appendleft(req)

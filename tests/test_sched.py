@@ -16,11 +16,11 @@ from butterfly_tpu.sched.scheduler import Scheduler
 CFG = tiny("llama", dtype="float32", param_dtype="float32")
 
 
-def make_sched(max_batch=2, max_seq=64, page=8, num_pages=0, seed=0):
+def make_sched(max_batch=2, max_seq=64, page=8, num_pages=0, seed=0, **rt_kw):
     model = Model(CFG)
     params = model.init(jax.random.PRNGKey(42))
     rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
-                       page_size=page, num_pages=num_pages)
+                       page_size=page, num_pages=num_pages, **rt_kw)
     return Scheduler(ServingEngine(model, params, rt), seed=seed), params
 
 
@@ -141,6 +141,79 @@ def test_cancel_running_request_frees_resources():
     assert r2.state == "finished"
     assert sched.alloc.free_pages == sched.alloc.num_pages
     assert sched.metrics()["requests_finished"] == 1
+
+
+def test_chunked_prefill_parity():
+    """A prompt far longer than prefill_chunk is prefilled in pieces that
+    continue the warm cache — output must still match the whole-prompt
+    reference exactly."""
+    prompt = list(range(2, 32))  # 30 tokens, chunk=8 -> 4 chunks
+    sched, params = make_sched(max_seq=64, prefill_chunk=8)
+    req = sched.submit(prompt, max_new_tokens=6)
+    sched.run_until_done()
+    assert req.output == ref_tokens(params, prompt, 6)
+
+
+def test_chunked_prefill_interleaves_decode():
+    """VERDICT r2 item 3: a long admission must not head-of-line-block a
+    decoding request — its inter-token gap stays at one tick per chunk."""
+    sched, params = make_sched(max_batch=2, max_seq=64, prefill_chunk=4)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=20)
+    sched.tick()
+    assert r1.state == "running" and len(r1.output) >= 1
+    long_prompt = list(range(1, 17))  # 16 tokens = 4 chunks of 4
+    r2 = sched.submit(long_prompt, max_new_tokens=4)
+    gaps = []
+    while r2.t_first_token is None:
+        before = len(r1.output)
+        sched.tick()
+        gaps.append(len(r1.output) - before)
+    # r2's prompt took multiple ticks to admit...
+    assert len(gaps) >= 4
+    # ...and r1 kept emitting exactly one token on EVERY one of them.
+    assert all(g == 1 for g in gaps)
+    sched.run_until_done()
+    assert r1.output == ref_tokens(params, [5, 7, 11], 20)
+    assert r2.output == ref_tokens(params, long_prompt, 4)
+
+
+def test_cancel_mid_prefill_frees_resources():
+    sched, _ = make_sched(max_batch=1, prefill_chunk=4)
+    r1 = sched.submit(list(range(1, 17)), max_new_tokens=8)
+    r2 = sched.submit([3], max_new_tokens=2)
+    sched.tick()
+    assert r1.state == "prefilling" and 0 < r1.prefilled < 16
+    sched.cancel(r1)
+    assert r1.state == "cancelled" and r1.slot is None
+    sched.run_until_done()
+    assert r2.state == "finished"
+    assert sched.alloc.free_pages == sched.alloc.num_pages
+
+
+def test_decode_steps_per_tick():
+    sched, params = make_sched(decode_steps_per_tick=3)
+    req = sched.submit([5, 7, 11], max_new_tokens=10)
+    sched.tick()  # admission (first token) + 3 decode steps
+    assert len(req.output) == 4
+    sched.run_until_done()
+    assert req.output == ref_tokens(params, [5, 7, 11], 10)
+
+
+def test_static_scheduler_drains_batches():
+    """scheduler="static": a waiting request is only admitted once the
+    in-flight batch has fully drained (no continuous admission)."""
+    sched, params = make_sched(max_batch=2, scheduler="static")
+    r1 = sched.submit([5, 7, 11], max_new_tokens=3)
+    r2 = sched.submit([3, 1], max_new_tokens=6)
+    sched.tick()
+    r3 = sched.submit([9], max_new_tokens=2)
+    while r3.t_first_token is None:
+        assert r3.state == "waiting"
+        sched.tick()
+    # r3 was only started after BOTH batch members finished
+    assert r1.done and r2.done
+    sched.run_until_done()
+    assert r3.output == ref_tokens(params, [9], 2)
 
 
 def test_cancel_waiting_request():
