@@ -117,6 +117,48 @@ def gather_paged_layer(pages: jax.Array, page_table: jax.Array) -> jax.Array:
 # Paged forward pass (reference path; Pallas decode kernel lives in ops/)
 # ---------------------------------------------------------------------------
 
+def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
+                     positions, mask, cos, sin, active, use_kernel: bool,
+                     fresh: bool):
+    """One transformer layer against one layer's page pool slice.
+
+    Shared by paged_forward's full-stack scan and the stage-local scan of
+    the pipeline serving path (parallel/pipeline.py) so the two cannot
+    drift. x: [B,T,D]; kp/vp: [P,page,Kv,H]; returns (x, kp, vp).
+    """
+    from butterfly_tpu.models.common import (
+        _cast_float, attend, attn_output, ffn_block, pre_norm, qkv_proj)
+
+    T = x.shape[1]
+    compute_dtype = jnp.dtype(cfg.dtype)
+    lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
+    start = positions[:, 0]
+
+    h = pre_norm(x, lp["ln1"], cfg)
+    q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+    kp, vp = write_paged_layer(kp, vp, page_table, k, v, start, active)
+    out = None
+    if use_kernel and T == 1:
+        from butterfly_tpu.ops.paged_attention import paged_attention_sharded
+        # lengths INCLUDING the token just written (inactive: 0 -> no
+        # pages visited, output discarded)
+        lens = jnp.where(active, positions[:, 0] + 1, 0)
+        out = paged_attention_sharded(q[:, 0], kp, vp, page_table, lens)
+        out = out[:, None] if out is not None else None
+    elif cfg.attn_impl == "flash" and T > 1 and fresh:
+        from butterfly_tpu.ops.flash_attention import flash_attention_sharded
+        out = flash_attention_sharded(q, k, v, causal=True)
+    if out is None:
+        # no mesh axis can shard the kernel operands (or kernels off):
+        # dense gather attention, which GSPMD partitions itself.
+        ck = gather_paged_layer(kp, page_table)
+        cv = gather_paged_layer(vp, page_table)
+        out = attend(q, ck, cv, mask, cfg)
+    x = x + attn_output(out, lp["attn"], cfg)
+    x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+    return x, kp, vp
+
+
 def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
                   cache: PagedKVCache,
                   positions: Optional[jax.Array] = None,
@@ -136,10 +178,7 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     gathering the full S_max view. Prefills (T>1) honor cfg.attn_impl
     ("flash" = Pallas blockwise kernel over the fresh K/V).
     """
-    from butterfly_tpu.models.common import (
-        attend, attn_output, embed_tokens, ffn_block, final_logits,
-        make_mask, pre_norm, qkv_proj)
-    import jax as _jax
+    from butterfly_tpu.models.common import embed_tokens, final_logits, make_mask
 
     B, T = tokens.shape
     if positions is None:
@@ -150,39 +189,13 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)
     mask = mask & active[:, None, None]
-    compute_dtype = jnp.dtype(cfg.dtype)
-    start = positions[:, 0]
 
     def body(x, scanned):
         lp, kp, vp = scanned
-        from butterfly_tpu.models.common import _cast_float
-        lp = _jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
-        h = pre_norm(x, lp["ln1"], cfg)
-        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-        kp, vp = write_paged_layer(kp, vp, cache.page_table, k, v, start,
-                                   active)
-        out = None
-        if use_kernel and T == 1:
-            from butterfly_tpu.ops.paged_attention import (
-                paged_attention_sharded)
-            # lengths INCLUDING the token just written (inactive: 0 -> no
-            # pages visited, output discarded)
-            lens = jnp.where(active, positions[:, 0] + 1, 0)
-            out = paged_attention_sharded(q[:, 0], kp, vp,
-                                          cache.page_table, lens)
-            out = out[:, None] if out is not None else None
-        elif cfg.attn_impl == "flash" and T > 1 and fresh:
-            from butterfly_tpu.ops.flash_attention import (
-                flash_attention_sharded)
-            out = flash_attention_sharded(q, k, v, causal=True)
-        if out is None:
-            # no mesh axis can shard the kernel operands (or kernels off):
-            # dense gather attention, which GSPMD partitions itself.
-            ck = gather_paged_layer(kp, cache.page_table)
-            cv = gather_paged_layer(vp, cache.page_table)
-            out = attend(q, ck, cv, mask, cfg)
-        x = x + attn_output(out, lp["attn"], cfg)
-        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        x, kp, vp = paged_layer_body(
+            x, lp, kp, vp, cfg=cfg, page_table=cache.page_table,
+            positions=positions, mask=mask, cos=cos, sin=sin, active=active,
+            use_kernel=use_kernel, fresh=fresh)
         return x, (kp, vp)
 
     x, (new_k, new_v) = lax.scan(

@@ -178,7 +178,10 @@ class RuntimeConfig:
     max_batch_size: int = 8
     max_seq_len: int = 2048
     prefill_chunk: int = 512          # max prefill tokens per scheduler tick;
-                                      # long prompts continue across ticks
+                                      # long prompts continue across ticks.
+                                      # NB: chunks pad to the engine's
+                                      # 16-token bucket floor — values < 16
+                                      # add compute without cutting latency
     page_size: int = 16               # paged-KV tokens per block
     num_pages: int = 0                # 0 => derive from max_batch/max_seq
     scheduler: str = "continuous"     # "continuous" (chunked-prefill/decode

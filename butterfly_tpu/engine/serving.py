@@ -67,11 +67,11 @@ class ServingEngine:
         self.runtime = runtime or RuntimeConfig()
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
-        if mesh is not None and mesh.shape.get("stage", 1) > 1:
-            raise NotImplementedError(
-                "stage-parallel serving is not supported yet: the paged "
-                "decode path scans the full layer stack; use tensor/data "
-                "axes (pipeline serving tracked for a later round)")
+        stage = mesh.shape.get("stage", 1) if mesh is not None else 1
+        if stage > 1 and self.cfg.num_layers % stage != 0:
+            raise ValueError(
+                f"{self.cfg.num_layers} layers not divisible by "
+                f"{stage} pipeline stages")
         if use_kernels is None:
             # Pallas kernels are TPU-only; under a mesh the call sites go
             # through ops/*_sharded (shard_map over data/tensor), so a
@@ -94,16 +94,25 @@ class ServingEngine:
             else:
                 self.params = shard_params(self.params, self.cfg, mesh)
             self.cache = shard_paged_cache(self.cache, self.cfg, mesh)
+        # stage>1 routes every paged program through the GPipe schedule
+        # (microbatches of slots; pool L dim stage-sharded to match).
+        if stage > 1:
+            from butterfly_tpu.parallel.pipeline import paged_pipeline_forward
+            fwd = partial(paged_pipeline_forward, mesh=mesh)
+        else:
+            fwd = paged_forward
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
             if use_kernels else self.cfg
         # Two prefill programs: fresh (start==0, may take the flash kernel)
         # and warm (chunk continuation — attends through the cache, dense).
         self._prefill = jax.jit(
-            partial(_prefill_slot, prefill_cfg, True), donate_argnums=(2, 3))
+            partial(_prefill_slot, prefill_cfg, True, fwd),
+            donate_argnums=(2, 3))
         self._prefill_warm = jax.jit(
-            partial(_prefill_slot, self.cfg, False), donate_argnums=(2, 3))
+            partial(_prefill_slot, self.cfg, False, fwd),
+            donate_argnums=(2, 3))
         self._decode = jax.jit(
-            partial(_decode_all, self.cfg, use_kernel=use_kernels),
+            partial(_decode_all, self.cfg, fwd, use_kernel=use_kernels),
             static_argnums=(5, 6), donate_argnums=(2,))
 
     def _mesh_ctx(self):
@@ -179,29 +188,29 @@ class ServingEngine:
         return self.runtime.top_p
 
 
-def _prefill_slot(cfg: ModelConfig, fresh: bool, params, tokens, k_pages,
-                  v_pages, table_row, true_len, start):
+def _prefill_slot(cfg: ModelConfig, fresh: bool, fwd, params, tokens,
+                  k_pages, v_pages, table_row, true_len, start):
     """[1,T] prompt chunk against the slot's table row; pool-wide scatter.
 
     `start` [1] is the chunk's first absolute position; `fresh` (static)
     means start==0 and the slot's pages are empty (flash-path eligible).
+    `fwd` is paged_forward or its stage-pipelined twin.
     """
     cache1 = PagedKVCache(k_pages, v_pages, table_row,
                           jnp.zeros((1,), jnp.int32))
     B, T = tokens.shape
     positions = start[:, None] + jnp.broadcast_to(jnp.arange(T)[None, :],
                                                   (B, T))
-    logits, cache1 = paged_forward(params, cfg, tokens, cache1, positions,
-                                   fresh=fresh)
+    logits, cache1 = fwd(params, cfg, tokens, cache1, positions, fresh=fresh)
     last = jnp.take_along_axis(logits, (true_len - 1)[:, None, None], axis=1)
     return last[:, 0, :], cache1.k_pages, cache1.v_pages
 
 
-def _decode_all(cfg: ModelConfig, params, tokens, cache: PagedKVCache,
+def _decode_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,
                 active, temps, top_k: int, top_p: float, key,
                 use_kernel: bool = False):
-    logits, cache = paged_forward(params, cfg, tokens[:, None], cache,
-                                  active=active, use_kernel=use_kernel)
+    logits, cache = fwd(params, cfg, tokens[:, None], cache,
+                        active=active, use_kernel=use_kernel)
     last = logits[:, -1, :]
     nxt = sample_batched(last, key, temps, top_k, top_p)
     return nxt, last, cache

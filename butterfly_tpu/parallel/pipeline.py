@@ -68,19 +68,160 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh)
     # Manual over `stage` only: layer-stacked leaves and the cache split
     # their leading L dim; activations/masks are replicated over stage.
-    # tensor/data stay auto (GSPMD) inside.
+    # tensor/data stay auto (GSPMD) inside. The microbatch-result output
+    # comes back stage-STACKED ([S*M, mb, T, D], only the last stage's
+    # block meaningful) rather than psum-replicated: slicing that block
+    # below moves one [B,T,D] activation off the last stage instead of
+    # all-reducing S zero-padded copies (VERDICT r2 weak item 4).
     layer_in = jax.tree.map(lambda _: P("stage"), params["layers"])
     pipe = jax.shard_map(
         body, mesh=mesh,
         in_specs=(layer_in, P("stage"), P("stage"),
                   P(), P(), P(), P(), P()),
-        out_specs=(P(), P("stage"), P("stage")),
+        out_specs=(P("stage"), P("stage"), P("stage")),
         axis_names={"stage"}, check_vma=False)
-    y, new_k, new_v = pipe(params["layers"], cache.k, cache.v,
-                           x, positions, mask, cos, sin)
+    outs, new_k, new_v = pipe(params["layers"], cache.k, cache.v,
+                              x, positions, mask, cos, sin)
+    y = outs[(S - 1) * M:].reshape(B, *x.shape[1:])
 
     logits = final_logits(params, cfg, y)
     return logits, KVCache(new_k, new_v, cache.length + T)
+
+
+def paged_pipeline_forward(params: Params, cfg: ModelConfig,
+                           tokens: jax.Array, cache,
+                           positions: Optional[jax.Array] = None,
+                           active: Optional[jax.Array] = None,
+                           use_kernel: bool = False, fresh: bool = False,
+                           *, mesh: Mesh,
+                           num_microbatches: Optional[int] = None):
+    """paged_forward pipelined over `stage` (PP serving, VERDICT r2 item 4).
+
+    Same contract as cache.paged.paged_forward — [B,T] tokens against the
+    shared page pool — but the layer stack and the pool's L dim are stage-
+    sharded and microbatches of slots flow through the GPipe schedule.
+    Block tables/lengths stay replicated over stage (page ownership is a
+    host concept); each stage scatters/gathers only its local layers'
+    pages. The Pallas kernels still engage inside the stage-manual region
+    (their wrappers shard_map over the still-Auto data/tensor axes).
+    """
+    from butterfly_tpu.cache.paged import PagedKVCache, paged_forward
+    from butterfly_tpu.models.common import (
+        embed_tokens, final_logits, make_mask)
+
+    S = mesh.shape["stage"]
+    if S == 1:
+        return paged_forward(params, cfg, tokens, cache, positions, active,
+                             use_kernel, fresh)
+    B, T = tokens.shape
+    if positions is None:
+        positions = cache.lengths[:, None] + jnp.arange(T)[None, :]
+    if active is None:
+        active = jnp.ones((B,), bool)
+    M = num_microbatches or _default_microbatches(B, S)
+    if B % M != 0:
+        raise ValueError(f"slots {B} not divisible by microbatches {M}")
+    if cfg.num_layers % S != 0:
+        raise ValueError(f"{cfg.num_layers} layers not divisible by {S} stages")
+
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    mask = make_mask(positions, cache.max_seq) & active[:, None, None]
+
+    body = partial(_paged_pipeline_body, cfg=cfg, S=S, M=M,
+                   use_kernel=use_kernel, fresh=fresh)
+    layer_in = jax.tree.map(lambda _: P("stage"), params["layers"])
+    pipe = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(layer_in, P("stage"), P("stage"),
+                  P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P("stage"), P("stage"), P("stage")),
+        axis_names={"stage"}, check_vma=False)
+    outs, new_k, new_v = pipe(params["layers"], cache.k_pages, cache.v_pages,
+                              x, cache.page_table, positions, mask, cos, sin,
+                              active)
+    y = outs[(S - 1) * M:].reshape(B, *x.shape[1:])
+
+    logits = final_logits(params, cfg, y)
+    new_len = jnp.where(active, cache.lengths + T, cache.lengths)
+    return logits, PagedKVCache(new_k, new_v, cache.page_table, new_len)
+
+
+def _gpipe_schedule(S: int, M: int, xs, step_fn, carry0):
+    """The GPipe tick skeleton shared by the contiguous and paged bodies.
+
+    Runs M + S - 1 ticks inside a stage-manual region; tick t has this
+    stage working on microbatch m = t - stage (bubble ticks have m out of
+    range). `step_fn(carry, mc, valid, inp) -> (y, carry)` runs this
+    stage's local layers on one microbatch and owns all cache write-back
+    masking for bubble ticks. xs is [M, mb, ...]; results are recorded
+    from the last stage and returned [M, mb, ...] (garbage elsewhere —
+    callers slice the last stage's block via out_specs P('stage')).
+    """
+    stage = lax.axis_index("stage")
+    state0 = jnp.zeros_like(xs[0])
+    out0 = jnp.zeros_like(xs)
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(c, t):
+        state, carry, outs = c
+        m = t - stage
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        inp = jnp.where(stage == 0, xs[jnp.clip(t, 0, M - 1)], state)
+        y, carry = step_fn(carry, mc, valid, inp)
+        rec = jnp.where(valid & (stage == S - 1), y, outs[mc])
+        outs = lax.dynamic_update_index_in_dim(outs, rec, mc, axis=0)
+        state = lax.ppermute(y, "stage", fwd_perm)
+        return (state, carry, outs), None
+
+    (_, carry, outs), _ = lax.scan(tick, (state0, carry0, out0),
+                                   jnp.arange(M + S - 1))
+    return outs, carry
+
+
+def _paged_pipeline_body(layers, k_pages, v_pages, x, page_table, positions,
+                         mask, cos, sin, active, *, cfg: ModelConfig,
+                         S: int, M: int, use_kernel: bool, fresh: bool):
+    """Per-stage GPipe body over the paged pool (manual over stage).
+
+    layers/k_pages/v_pages are the local [L/S, ...] stage slice; x, the
+    block table, and the per-token aux arrays are full-slot-batch and
+    replicated over stage.
+    """
+    from butterfly_tpu.cache.paged import paged_layer_body
+
+    B = x.shape[0]
+    mb = B // M
+
+    xs = x.reshape(M, mb, *x.shape[1:])
+    tbl_mb = page_table.reshape(M, mb, *page_table.shape[1:])
+    pos_mb = positions.reshape(M, mb, *positions.shape[1:])
+    mask_mb = mask.reshape(M, mb, *mask.shape[1:])
+    cos_mb = cos.reshape(M, mb, *cos.shape[1:])
+    sin_mb = sin.reshape(M, mb, *sin.shape[1:])
+    act_mb = active.reshape(M, mb)
+
+    def step(carry, mc, valid, inp):
+        kp, vp = carry
+        # bubble ticks redirect their pool writes to the null page via the
+        # active mask (the paged analogue of the contiguous path's
+        # where(valid) write-back)
+        act = act_mb[mc] & valid
+
+        def layer(x, scanned):
+            lp, kpl, vpl = scanned
+            x, kpl, vpl = paged_layer_body(
+                x, lp, kpl, vpl, cfg=cfg, page_table=tbl_mb[mc],
+                positions=pos_mb[mc], mask=mask_mb[mc], cos=cos_mb[mc],
+                sin=sin_mb[mc], active=act, use_kernel=use_kernel,
+                fresh=fresh)
+            return x, (kpl, vpl)
+
+        y, (kp, vp) = lax.scan(layer, inp, (layers, kp, vp))
+        return y, (kp, vp)
+
+    outs, (kp, vp) = _gpipe_schedule(S, M, xs, step, (k_pages, v_pages))
+    return outs, kp, vp
 
 
 def _default_microbatches(B: int, S: int) -> int:
@@ -96,12 +237,13 @@ def _default_microbatches(B: int, S: int) -> int:
 def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
                    *, cfg: ModelConfig, S: int, M: int,
                    fresh: bool = False):
-    """Per-stage GPipe schedule (runs inside shard_map, manual over stage).
+    """Per-stage GPipe body, contiguous cache (manual over stage).
 
     layers/ck/cv are the local [L/S, ...] stage slice; x [B,T,D] etc. are
-    full-batch and replicated over stage.
+    full-batch and replicated over stage. Returns outs stage-stacked
+    (real results only on the last stage — out_specs P('stage'), caller
+    slices — no [B,T,D] all-reduce over `stage`).
     """
-    stage = lax.axis_index("stage")
     B = x.shape[0]
     mb = B // M
 
@@ -112,17 +254,8 @@ def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
     cos_mb = cos.reshape(M, mb, *cos.shape[1:])
     sin_mb = sin.reshape(M, mb, *sin.shape[1:])
 
-    state0 = jnp.zeros_like(xs[0])          # activation entering this stage
-    out0 = jnp.zeros_like(xs)               # last stage's results
-    fwd_perm = [(i, i + 1) for i in range(S - 1)]
-
-    def tick(carry, t):
-        state, ck, cv, outs = carry
-        m = t - stage                        # microbatch this stage works on
-        valid = (m >= 0) & (m < M)
-        mc = jnp.clip(m, 0, M - 1)
-
-        inp = jnp.where(stage == 0, xs[jnp.clip(t, 0, M - 1)], state)
+    def step(carry, mc, valid, inp):
+        ck, cv = carry
         ck_m = lax.dynamic_slice_in_dim(ck, mc * mb, mb, axis=1)
         cv_m = lax.dynamic_slice_in_dim(cv, mc * mb, mb, axis=1)
 
@@ -130,22 +263,12 @@ def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
                                 pos_mb[mc], mask_mb[mc], cos_mb[mc],
                                 sin_mb[mc], fresh)
 
-        # write back cache/output only on valid (non-bubble) ticks
+        # write back cache only on valid (non-bubble) ticks
         nk = jnp.where(valid, nk, ck_m)
         nv = jnp.where(valid, nv, cv_m)
         ck = lax.dynamic_update_slice_in_dim(ck, nk, mc * mb, axis=1)
         cv = lax.dynamic_update_slice_in_dim(cv, nv, mc * mb, axis=1)
+        return y, (ck, cv)
 
-        rec = jnp.where(valid & (stage == S - 1), y, outs[mc])
-        outs = lax.dynamic_update_index_in_dim(outs, rec, mc, axis=0)
-
-        state = lax.ppermute(y, "stage", fwd_perm)
-        return (state, ck, cv, outs), None
-
-    (_, ck, cv, outs), _ = lax.scan(
-        tick, (state0, ck, cv, out0), jnp.arange(M + S - 1))
-
-    # outs is only meaningful on the last stage; replicate it via psum.
-    outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
-    outs = lax.psum(outs, "stage")
-    return outs.reshape(B, *x.shape[1:]), ck, cv
+    outs, (ck, cv) = _gpipe_schedule(S, M, xs, step, (ck, cv))
+    return outs, ck, cv

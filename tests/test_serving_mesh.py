@@ -94,9 +94,53 @@ def test_meshed_pool_is_sharded(params, mesh):
     assert eng.cache.page_table.sharding.spec[0] == "data"
 
 
-def test_stage_parallel_serving_rejected(params):
+def test_stage_parallel_scheduler_token_parity(params):
+    """VERDICT r2 item 4: pipeline-parallel serving — the paged decode
+    path runs the GPipe schedule per stage slice; token-exact vs the
+    unmeshed scheduler."""
+    ref = _make_sched(params)
+    ref_reqs = [ref.submit(p, max_new_tokens=6) for p in PROMPTS]
+    ref.run_until_done()
+
+    mesh = make_mesh(MeshConfig(stage=2, tensor=4))
+    sched = _make_sched(params, mesh=mesh)
+    reqs = [sched.submit(p, max_new_tokens=6) for p in PROMPTS]
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        sched.run_until_done()
+    assert [r.output for r in reqs] == [r.output for r in ref_reqs]
+    bad = [str(w.message) for w in rec
+           if "donated buffers were not usable" in str(w.message)]
+    assert not bad, f"stage-parallel serving donation failed to alias: {bad}"
+
+
+def test_stage_data_parallel_scheduler_token_parity(params):
+    """PP x DP: slots sharded over data while microbatches of slots flow
+    through the stage schedule."""
+    ref = _make_sched(params)
+    ref_reqs = [ref.submit(p, max_new_tokens=5) for p in PROMPTS]
+    ref.run_until_done()
+
     mesh = make_mesh(MeshConfig(stage=2, data=4))
-    with pytest.raises(NotImplementedError):
+    sched = _make_sched(params, mesh=mesh)
+    reqs = [sched.submit(p, max_new_tokens=5) for p in PROMPTS]
+    sched.run_until_done()
+    assert [r.output for r in reqs] == [r.output for r in ref_reqs]
+
+
+def test_stage_pool_is_stage_sharded(params):
+    mesh = make_mesh(MeshConfig(stage=2, tensor=4))
+    eng = ServingEngine(Model(CFG), params,
+                        RuntimeConfig(max_batch_size=4, max_seq_len=64,
+                                      page_size=8), mesh=mesh)
+    spec = eng.cache.k_pages.sharding.spec
+    assert spec[0] == "stage"   # each stage owns its layers' pages
+    assert spec[3] == "tensor"
+
+
+def test_stage_indivisible_layers_rejected(params):
+    mesh = make_mesh(MeshConfig(stage=4, data=2))  # 2 layers, 4 stages
+    with pytest.raises(ValueError, match="not divisible"):
         ServingEngine(Model(CFG), params, RuntimeConfig(), mesh=mesh)
 
 
