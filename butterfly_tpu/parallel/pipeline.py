@@ -66,26 +66,36 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     mask = make_mask(positions, cache.max_seq)
 
     body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh)
-    # Manual over `stage` only: layer-stacked leaves and the cache split
-    # their leading L dim; activations/masks are replicated over stage.
-    # tensor/data stay auto (GSPMD) inside. The microbatch-result output
-    # comes back stage-STACKED ([S*M, mb, T, D], only the last stage's
-    # block meaningful) rather than psum-replicated: slicing that block
-    # below moves one [B,T,D] activation off the last stage instead of
-    # all-reducing S zero-padded copies (VERDICT r2 weak item 4).
-    layer_in = jax.tree.map(lambda _: P("stage"), params["layers"])
-    pipe = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(layer_in, P("stage"), P("stage"),
-                  P(), P(), P(), P(), P()),
-        out_specs=(P("stage"), P("stage"), P("stage")),
-        axis_names={"stage"}, check_vma=False)
-    outs, new_k, new_v = pipe(params["layers"], cache.k, cache.v,
-                              x, positions, mask, cos, sin)
-    y = outs[(S - 1) * M:].reshape(B, *x.shape[1:])
-
+    y, (new_k, new_v) = _run_gpipe(body, mesh, params["layers"],
+                                   (cache.k, cache.v),
+                                   (x, positions, mask, cos, sin), S, M, x)
     logits = final_logits(params, cfg, y)
     return logits, KVCache(new_k, new_v, cache.length + T)
+
+
+def _run_gpipe(body, mesh: Mesh, layers, stage_ops, rep_ops, S: int, M: int,
+               x: jax.Array):
+    """shard_map a GPipe body and slice the last stage's result block.
+
+    Shared scaffolding for the contiguous and paged pipelines. Manual
+    over `stage` only: layer-stacked leaves and `stage_ops` (the cache
+    pytree leaves) split their leading L dim; `rep_ops` (activations,
+    masks, tables) are replicated over stage; tensor/data stay auto
+    (GSPMD) inside. The body's microbatch results come back stage-
+    STACKED ([S*M, mb, ...], only the last stage's block meaningful)
+    rather than psum-replicated: slicing that block moves ONE [B,T,D]
+    activation off the last stage instead of all-reducing S zero-padded
+    copies (VERDICT r2 weak item 4).
+    """
+    layer_in = jax.tree.map(lambda _: P("stage"), layers)
+    pipe = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(layer_in, *([P("stage")] * len(stage_ops)),
+                  *([P()] * len(rep_ops))),
+        out_specs=(P("stage"), *([P("stage")] * len(stage_ops))),
+        axis_names={"stage"}, check_vma=False)
+    outs, *new_stage = pipe(layers, *stage_ops, *rep_ops)
+    return outs[(S - 1) * M:].reshape(x.shape), tuple(new_stage)
 
 
 def paged_pipeline_forward(params: Params, cfg: ModelConfig,
@@ -129,18 +139,9 @@ def paged_pipeline_forward(params: Params, cfg: ModelConfig,
 
     body = partial(_paged_pipeline_body, cfg=cfg, S=S, M=M,
                    use_kernel=use_kernel, fresh=fresh)
-    layer_in = jax.tree.map(lambda _: P("stage"), params["layers"])
-    pipe = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(layer_in, P("stage"), P("stage"),
-                  P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P("stage"), P("stage"), P("stage")),
-        axis_names={"stage"}, check_vma=False)
-    outs, new_k, new_v = pipe(params["layers"], cache.k_pages, cache.v_pages,
-                              x, cache.page_table, positions, mask, cos, sin,
-                              active)
-    y = outs[(S - 1) * M:].reshape(B, *x.shape[1:])
-
+    y, (new_k, new_v) = _run_gpipe(
+        body, mesh, params["layers"], (cache.k_pages, cache.v_pages),
+        (x, cache.page_table, positions, mask, cos, sin, active), S, M, x)
     logits = final_logits(params, cfg, y)
     new_len = jnp.where(active, cache.lengths + T, cache.lengths)
     return logits, PagedKVCache(new_k, new_v, cache.page_table, new_len)

@@ -153,6 +153,118 @@ def sp_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return logits, cache
 
 
+def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                   positions: jax.Array, prefix: KVCache, suffix: KVCache,
+                   mesh: Mesh) -> Tuple[jax.Array, KVCache]:
+    """One decode step consuming sp_forward's sequence-sharded cache.
+
+    The long prefix stays sharded over `seq` exactly where prefill left it
+    (never regathered); generated tokens live in a small replicated
+    contiguous `suffix` cache. Attention is computed as one online-softmax
+    merge (ring_attention's accumulator algebra): each device attends its
+    local prefix chunk into partial (m, l, acc), the partials merge across
+    the ring with pmax/psum — collectives sized [B,Nq,H], never [B,T,*] —
+    and the suffix block folds in locally.
+
+    tokens/positions: [B,1] (positions = prefix length + step).
+    Returns (last-token logits [B,V], suffix cache with the new K/V).
+
+    Capacity contract (as for the paged pool, where the host allocator
+    guarantees pages): the caller must size the suffix cache for the
+    whole decode run — a step past suffix.max_seq would clamp its write
+    onto the last slot. Checked eagerly when lengths are concrete.
+    """
+    if not isinstance(suffix.length, jax.core.Tracer):
+        if int(jnp.max(suffix.length)) >= suffix.max_seq:
+            raise ValueError(
+                f"suffix cache full ({suffix.max_seq} slots): size "
+                "init_cache(max_seq=...) for the whole decode run")
+    body = partial(_sp_decode_body, cfg=cfg)
+    layer_in = jax.tree.map(lambda _: P(), params["layers"])
+    head = {k: v for k, v in params.items() if k != "layers"}
+    head_in = jax.tree.map(lambda _: P(), head)
+    seq_kv = P(None, None, "seq")  # [L,B,T,Kv,H]: local T chunk per device
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(layer_in, head_in, P(), P(), seq_kv, seq_kv,
+                  P(), P(), P()),
+        out_specs=(P(), P(), P()),
+        axis_names={"seq"}, check_vma=False)
+    logits, new_sk, new_sv = fn(params["layers"], head, tokens, positions,
+                                prefix.k, prefix.v, suffix.k, suffix.v,
+                                suffix.length)
+    return logits, KVCache(new_sk, new_sv, suffix.length + 1)
+
+
+def _sp_decode_body(layers, head, tokens, positions, pk, pv, sck, scv, slen,
+                    *, cfg: ModelConfig):
+    """Per-device decode step (inside shard_map, manual over seq)."""
+    from butterfly_tpu.models.common import update_cache_layer
+
+    B = tokens.shape[0]
+    Smax = sck.shape[2]
+    x, cos, sin = embed_tokens(head, cfg, tokens, positions)
+    compute_dtype = jnp.dtype(cfg.dtype)
+    H = cfg.head_dim
+    Kv = cfg.num_kv_heads
+    G = cfg.num_heads // Kv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(H, jnp.float32))
+    # suffix causal mask: slots 0..slen (inclusive of the token written
+    # this step) are visible; everything prefix-side is older than the
+    # query by construction, so the prefix needs no mask at all.
+    j = jnp.arange(Smax)
+    suf_mask = j[None, :] <= slen[:, None]                   # [B,Smax]
+
+    def layer(x, scanned):
+        lp, pkl, pvl, ck, cv = scanned
+        from butterfly_tpu.models.common import _cast_float
+        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
+        h = pre_norm(x, lp["ln1"], cfg)
+        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)     # q [B,1,Nq,H]
+        ck, cv = update_cache_layer(ck, cv, k, v, slen)
+        qg = q.reshape(B, 1, Kv, G, H)
+
+        # local prefix chunk -> partial online-softmax accumulators
+        s_p = jnp.einsum("btkgh,bskh->bktgs", qg, pkl,
+                         preferred_element_type=jnp.float32) * scale
+        m_i = jnp.max(s_p, axis=-1)                          # [B,Kv,1,G]
+        p_i = jnp.exp(s_p - m_i[..., None])
+        l_i = jnp.sum(p_i, axis=-1)
+        acc_i = jnp.einsum("bktgs,bskh->bktgh", p_i,
+                           pvl.astype(jnp.float32))
+        # merge partials across the seq ring (tiny collectives: [B,Kv,G,*])
+        m_g = lax.pmax(m_i, "seq")
+        corr = jnp.exp(m_i - m_g)
+        l_g = lax.psum(l_i * corr, "seq")
+        acc_g = lax.psum(acc_i * corr[..., None], "seq")
+
+        # suffix block (replicated): masked scores + merge with prefix
+        s_s = jnp.einsum("btkgh,bskh->bktgs", qg,
+                         ck.astype(compute_dtype),
+                         preferred_element_type=jnp.float32) * scale
+        s_s = jnp.where(suf_mask[:, None, None, None, :], s_s, NEG)
+        m_s = jnp.max(s_s, axis=-1)
+        p_s = jnp.exp(s_s - m_s[..., None])
+        p_s = jnp.where(s_s <= NEG, 0.0, p_s)
+        l_s = jnp.sum(p_s, axis=-1)
+        acc_s = jnp.einsum("bktgs,bskh->bktgh", p_s,
+                           cv.astype(jnp.float32))
+
+        m_f = jnp.maximum(m_g, m_s)
+        c_g, c_s = jnp.exp(m_g - m_f), jnp.exp(m_s - m_f)
+        denom = l_g * c_g + l_s * c_s
+        out = (acc_g * c_g[..., None] + acc_s * c_s[..., None]) \
+            / jnp.maximum(denom, 1e-30)[..., None]
+        out = out.transpose(0, 2, 1, 3, 4).reshape(B, 1, Kv * G, H)
+        x = x + attn_output(out.astype(x.dtype), lp["attn"], cfg)
+        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        return x, (ck, cv)
+
+    x, (new_sk, new_sv) = lax.scan(layer, x, (layers, pk, pv, sck, scv))
+    logits = final_logits(head, cfg, x)
+    return logits[:, -1, :], new_sk, new_sv
+
+
 def _sp_body(layers, head, tokens, *, cfg: ModelConfig, impl: str):
     """Per-device chunk of the model (inside shard_map, manual over seq)."""
     idx = lax.axis_index("seq")

@@ -135,8 +135,12 @@ def test_pipeline_no_full_output_allreduce():
         # tensor-axis reduces (embedding-gather psum, Megatron) have
         # Sz == 4 here and are allowed; anything whose groups span the
         # stage axis (Sz == 2 or 8) must be microbatch-sized or smaller.
+        # Unparseable groups fail LOUDLY (a format change must not turn
+        # this guard vacuous).
         m = re.search(r"replica_groups=\[\d+,(\d+)\]", lhs[1])
-        if m is None or int(m.group(1)) == mesh.shape["tensor"]:
+        assert m is not None, \
+            f"unparseable replica_groups (update regex): {line.strip()[:160]}"
+        if int(m.group(1)) == mesh.shape["tensor"]:
             continue
         shapes = re.findall(r"\[([\d,]+)\]", lhs[1].split("(")[0])
         for sh in shapes:
