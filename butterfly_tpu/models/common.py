@@ -234,6 +234,19 @@ def mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum("btf,fd->btd", h, maybe_dequant(p["w_down"], dt))
 
 
+def route_tokens(x: jax.Array, router_w: jax.Array,
+                 k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k MoE routing: f32 logits -> (gates [.., k], expert idx [.., k]).
+
+    Softmax is over the SELECTED k (Mixtral convention). The single
+    definition shared by the dense block and both EP dispatch paths —
+    their exact-parity contract depends on byte-identical routing.
+    """
+    logits = jnp.einsum("btd,de->bte", x, router_w).astype(jnp.float32)
+    gates, idx = lax.top_k(logits, k)
+    return jax.nn.softmax(gates, axis=-1), idx
+
+
 def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     """Dense-compute MoE (every expert sees every token, masked by router).
 
@@ -241,9 +254,7 @@ def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     dense form is the single-device reference and the EP fallback.
     """
     B, T, D = x.shape
-    logits = jnp.einsum("btd,de->bte", x, p["router"]).astype(jnp.float32)
-    weights, idx = lax.top_k(logits, cfg.num_experts_per_tok)
-    weights = jax.nn.softmax(weights, axis=-1)  # [B,T,k]
+    weights, idx = route_tokens(x, p["router"], cfg.num_experts_per_tok)
     onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.float32)  # [B,T,k,E]
     comb = jnp.einsum("btk,btke->bte", weights, onehot)  # [B,T,E]
 

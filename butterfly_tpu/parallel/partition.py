@@ -201,7 +201,11 @@ def compiled_hlo(fn, *args, mesh: Optional[Mesh] = None, **jit_kw) -> str:
     """Lower+compile fn under `mesh` and return optimized HLO text."""
     jfn = jax.jit(fn, **jit_kw)
     if mesh is not None:
-        with mesh:
+        # set_mesh (not the bare context manager): it also installs the
+        # abstract mesh that mesh-aware call sites (kernel wrappers, EP
+        # a2a dispatch) consult during tracing — matching how the engines
+        # actually run.
+        with jax.set_mesh(mesh):
             lowered = jfn.lower(*args)
     else:
         lowered = jfn.lower(*args)

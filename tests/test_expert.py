@@ -87,11 +87,10 @@ def test_ep_forward_parity_on_mesh():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_ep_hlo_has_expert_comm():
-    """Expert-sharded weights + data-sharded tokens force cross-device
-    movement at dispatch/combine. GSPMD picks the op (all-to-all on real
-    TPU shapes; its CPU heuristics may prefer all-gather + all-reduce on
-    tiny shapes) — assert communication exists, not the exact lowering."""
+def test_ep_hlo_has_real_all_to_all():
+    """VERDICT r2 item 7: prefill dispatch must be an explicit
+    lax.all_to_all (scatter + a2a path), not whatever GSPMD makes of a
+    one-hot einsum — the compiled HLO must contain a real all-to-all."""
     cfg = moe_cfg(moe_impl="ep")
     mesh = make_mesh(MeshConfig(data=2, expert=4))
     params = shard_params(Model(cfg).init(jax.random.PRNGKey(0)), cfg, mesh)
@@ -101,4 +100,39 @@ def test_ep_hlo_has_expert_comm():
     hlo = compiled_hlo(lambda p, t, c: forward(p, cfg, t, c),
                        params, tokens, cache, mesh=mesh)
     counts = count_collectives(hlo)
-    assert sum(counts.values()) > 0, f"no collectives in EP HLO: {counts}"
+    assert counts["all-to-all"] >= 2, \
+        f"EP prefill dispatch/combine not lowered to all-to-all: {counts}"
+
+
+def test_ep_a2a_long_prefill_fits_memory():
+    """VERDICT r2 item 7 'done' criterion: a Mixtral-shaped T=2048
+    prefill block must fit fake-device memory. The old one-hot dispatch
+    tensor would be [B,T,k,E,C] = 2048*2*8*2048 ~ 67M elements per
+    einsum operand pair; the a2a path keeps O(B*T*k) indices + [E,C,D]
+    buffers, and still matches the dense reference exactly."""
+    cfg = moe_cfg(num_experts=8, moe_capacity_factor=8.0)  # no-drop
+    mesh = make_mesh(MeshConfig(expert=8))
+    params = Model(cfg).init(jax.random.PRNGKey(3))
+    p = layer0_moe(params)
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 2048, cfg.hidden_size))
+    with jax.set_mesh(mesh):
+        out = jax.jit(lambda x, p: moe_block_ep(x, p, cfg))(x, p)
+        out.block_until_ready()
+    dense = moe_block(x, p, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ep_decode_step_falls_back_to_einsum_path():
+    """T==1 (decode) can't sequence-shard over expert: the einsum path
+    must engage and still match dense."""
+    cfg = moe_cfg(num_experts=4, moe_capacity_factor=4.0)
+    mesh = make_mesh(MeshConfig(expert=4, data=2))
+    params = Model(cfg).init(jax.random.PRNGKey(5))
+    p = layer0_moe(params)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 1, cfg.hidden_size))
+    with jax.set_mesh(mesh):
+        out = jax.jit(lambda x, p: moe_block_ep(x, p, cfg))(x, p)
+    dense = moe_block(x, p, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
