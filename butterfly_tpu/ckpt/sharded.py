@@ -66,7 +66,7 @@ def save_serving_snapshot(path: str, scheduler) -> None:
     and their KV recomputed — the paged pool itself is NOT checkpointed
     (recompute beats serializing terabytes of KV)."""
     reqs = []
-    for r in list(scheduler.running) + list(scheduler.waiting):
+    for r in scheduler.unfinished_requests():
         reqs.append({
             "prompt": r.prompt, "output": r.output,
             "max_new_tokens": r.max_new_tokens,

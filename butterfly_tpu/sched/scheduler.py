@@ -136,11 +136,17 @@ class Scheduler:
             live.append(self._prefilling)
         return live
 
+    def unfinished_requests(self) -> List[Request]:
+        """Every request that would be lost in a crash: running,
+        mid-chunked-prefill, and waiting — the set a serving snapshot
+        (ckpt.sharded.save_serving_snapshot) must persist."""
+        return self._all_live + list(self.waiting)
+
     def abort_all(self) -> None:
         """Wedge-path drain: host-only bookkeeping, NO device calls (the
         device may be the thing that's broken). Every waiter's on_finish
         fires; slots/pages are reclaimed in host state only."""
-        for req in self._all_live + list(self.waiting):
+        for req in self.unfinished_requests():
             req.state = "cancelled"
             req.t_finish = time.monotonic()
             if req.slot is not None:

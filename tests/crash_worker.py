@@ -1,0 +1,46 @@
+"""Worker for the kill-a-host fault-injection test (run as a subprocess).
+
+Runs a Scheduler partway through a batch of requests, writes a serving
+snapshot (ckpt.sharded.save_serving_snapshot), then spins so the parent
+can SIGKILL it with live, unfinished work — simulating a host crash whose
+queued work must be recoverable from the snapshot alone.
+
+Usage: python crash_worker.py <snapshot_path> <ticks_before_spin>
+"""
+import sys
+import time
+
+
+def main() -> None:
+    snap_path, ticks = sys.argv[1], int(sys.argv[2])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from butterfly_tpu.ckpt.sharded import save_serving_snapshot
+    from butterfly_tpu.core.config import RuntimeConfig, tiny
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                       prefill_chunk=2)  # force a mid-prefill request too
+    sched = Scheduler(ServingEngine(model, params, rt))
+    sched.submit([5, 7, 11], max_new_tokens=12)
+    sched.submit([3, 1], max_new_tokens=10)
+    sched.submit([2, 4, 6, 8, 10, 12], max_new_tokens=8)  # chunked prefill
+
+    for _ in range(ticks):
+        sched.tick()
+    assert sched.has_work, "worker drained before the crash point"
+    save_serving_snapshot(snap_path + ".tmp", sched)
+    import os
+    os.replace(snap_path + ".tmp", snap_path)  # atomic publish
+    while True:  # parent SIGKILLs us here, mid-flight
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

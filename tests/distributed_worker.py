@@ -1,0 +1,49 @@
+"""Worker for the 2-process jax.distributed test (run as a subprocess).
+
+Exercises core.mesh.init_distributed — the multi-host control-plane
+bringup (SURVEY.md §3 call stack 3) — on the CPU backend: DCN-style
+rendezvous via the coordinator, a global mesh over both processes'
+devices, and one cross-process psum through shard_map.
+
+Usage: python distributed_worker.py <process_id> <num_processes> <port>
+"""
+import sys
+
+
+def main() -> None:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from butterfly_tpu.core.config import MeshConfig
+    from butterfly_tpu.core.mesh import init_distributed, make_mesh
+
+    init_distributed(coordinator=f"127.0.0.1:{port}", num_processes=n,
+                     process_id=pid)
+    assert jax.process_count() == n, jax.process_count()
+    assert jax.device_count() == n * jax.local_device_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(MeshConfig(data=jax.device_count()))
+    # each process contributes its local shard(s) of a data-sharded array
+    sharding = NamedSharding(mesh, P("data"))
+    local = [jnp.full((1,), float(pid * jax.local_device_count() + i + 1))
+             for i in range(jax.local_device_count())]
+    garr = jax.make_array_from_single_device_arrays(
+        (jax.device_count(),), sharding, [
+            jax.device_put(x, d) for x, d in
+            zip(local, mesh.local_devices)])
+    out = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(), check_vma=False))(garr)
+    total = float(np.asarray(out)[0])
+    expect = sum(range(1, jax.device_count() + 1))
+    assert total == expect, (total, expect)
+    print(f"proc{pid} psum_ok {total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

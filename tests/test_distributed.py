@@ -1,0 +1,121 @@
+"""Multi-process + fault-injection tests (SURVEY.md §5 failure-detection
+row; VERDICT r2 item 8).
+
+* 2-process jax.distributed bringup on the CPU backend: real coordinator
+  rendezvous, a global mesh spanning both processes, one cross-process
+  psum (Gloo collectives) — exercised through core.mesh.init_distributed.
+* Kill-a-host recovery: a subprocess scheduler is SIGKILLed with live
+  requests (running, waiting AND mid-chunked-prefill); the parent
+  restores its serving snapshot into a fresh scheduler and the recovered
+  outputs must match an uninterrupted reference token-for-token.
+
+Both spawn subprocesses with a clean 1-device CPU env (the parent's
+8-fake-device XLA_FLAGS is stripped).
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+
+REPO = Path(__file__).resolve().parent.parent
+HERE = Path(__file__).resolve().parent
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""  # 1 local CPU device per process
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_psum():
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, str(HERE / "distributed_worker.py"),
+         str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_child_env(), text=True) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, f"worker failed:\n{out}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} psum_ok" in out, out
+
+
+def test_kill_one_process_recovers_queued_work(tmp_path):
+    """SIGKILL a serving process mid-flight; the snapshot alone must let a
+    fresh scheduler finish every request with exactly the tokens an
+    uninterrupted run produces (greedy recompute-from-prefix)."""
+    from butterfly_tpu.ckpt.sharded import restore_serving_snapshot
+    from butterfly_tpu.core.config import RuntimeConfig, tiny
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    snap = tmp_path / "serving_snapshot.json"
+    proc = subprocess.Popen(
+        [sys.executable, str(HERE / "crash_worker.py"), str(snap), "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_child_env(), text=True)
+    try:
+        deadline = time.monotonic() + 240
+        while not snap.exists():
+            assert proc.poll() is None, \
+                f"worker died early:\n{proc.communicate()[0]}"
+            assert time.monotonic() < deadline, "snapshot never appeared"
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGKILL)  # the host "crash"
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    data = json.loads(snap.read_text())
+    assert len(data["requests"]) == 3  # incl. the mid-chunked-prefill one
+    partial = {tuple(r["prompt"]): r["output"] for r in data["requests"]}
+
+    # same model/params as the worker (deterministic init from the seed)
+    cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+
+    sched = Scheduler(ServingEngine(model, params, rt))
+    n = restore_serving_snapshot(snap, sched)
+    assert n == 3
+    recovered = {tuple(r.prompt): r for r in
+                 list(sched.running) + list(sched.waiting)}
+    sched.run_until_done()
+
+    # uninterrupted reference
+    ref = Scheduler(ServingEngine(model, params, rt))
+    specs = [([5, 7, 11], 12), ([3, 1], 10), ([2, 4, 6, 8, 10, 12], 8)]
+    ref_reqs = [ref.submit(p, max_new_tokens=m) for p, m in specs]
+    ref.run_until_done()
+
+    for (prompt, _), ref_req in zip(specs, ref_reqs):
+        pre = partial[tuple(prompt)]
+        # restore resubmits prompt+partial-output as the new prompt
+        rec = recovered[tuple(prompt) + tuple(pre)]
+        assert rec.state == "finished"
+        assert pre + rec.output == ref_req.output, \
+            f"recovered tokens diverge for prompt {prompt}"
