@@ -1,11 +1,16 @@
 """Host-side page allocator for the paged KV cache.
 
-Pure-Python free-list bookkeeping (the device only ever sees the static
-page pool and int32 block tables — no dynamic shapes under jit). The
-scheduler asks `ensure_capacity` before every device step; a False answer
-means the request must wait or a running one must be preempted
-(sched/scheduler.py policy). Page P-1 is the reserved null page
-(cache/paged.py) and is never handed out.
+Free-list bookkeeping (the device only ever sees the static page pool
+and int32 block tables — no dynamic shapes under jit). The scheduler
+asks `can_grow`/`grow` before every device step; a refusal means the
+request must wait or a running one must be preempted (sched/scheduler.py
+policy). Page P-1 is the reserved null page (cache/paged.py) and is
+never handed out.
+
+Two interchangeable backends (identical semantics, parity-tested in
+tests/test_native.py): this pure-Python class, and the C++ free list in
+native/allocator.cc loaded via ctypes (butterfly_tpu.native). Use
+`make_page_allocator` to get the native one when the lib is built.
 """
 from __future__ import annotations
 
@@ -62,3 +67,13 @@ class PageAllocator:
         pages = self._owned.pop(slot, [])
         self._free.extend(reversed(pages))
         return pages
+
+
+def make_page_allocator(num_pages: int, page_size: int,
+                        max_pages_per_seq: int, num_slots: int = 4096):
+    """Native (C++) allocator when the lib is built, else pure Python."""
+    from butterfly_tpu.native import NativePageAllocator, native_available
+    if native_available():
+        return NativePageAllocator(num_pages, page_size, max_pages_per_seq,
+                                   num_slots)
+    return PageAllocator(num_pages, page_size, max_pages_per_seq)

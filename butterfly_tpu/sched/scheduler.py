@@ -36,7 +36,7 @@ from typing import Callable, Deque, Dict, List, Optional
 import jax
 import numpy as np
 
-from butterfly_tpu.cache.allocator import PageAllocator
+from butterfly_tpu.cache.allocator import make_page_allocator
 from butterfly_tpu.engine.serving import ServingEngine, sample_batched
 
 
@@ -85,8 +85,9 @@ class Scheduler:
             raise ValueError(f"unknown scheduler {rt.scheduler!r}: "
                              "expected 'continuous' or 'static'")
         max_pages = engine.cache.page_table.shape[1]
-        self.alloc = PageAllocator(engine.cache.num_pages - 1,
-                                   engine.cache.page_size, max_pages)
+        self.alloc = make_page_allocator(engine.cache.num_pages - 1,
+                                         engine.cache.page_size, max_pages,
+                                         num_slots=engine.num_slots)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
         self._prefilling: Optional[Request] = None  # mid-chunked-prefill

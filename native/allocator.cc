@@ -1,0 +1,120 @@
+// Host-side page allocator for the paged KV cache — native runtime half.
+//
+// The reference scaffold planned a native (Rust) runtime around its
+// engine (/root/reference/.gitignore:1-4 is a Cargo template; no code
+// exists — SURVEY.md §0). This is the TPU-framework equivalent piece:
+// the allocator sits on the scheduler's per-tick hot path (admission,
+// just-in-time decode growth, preemption release) and owns no device
+// state — the device only ever sees static pools and int32 block tables.
+//
+// Semantics are EXACTLY cache/allocator.py's PageAllocator (the Python
+// fallback): LIFO free-list handing out low page ids first, per-slot
+// ordered ownership lists, all-or-nothing grow, release returns pages
+// in reverse so allocation order is stable across either backend.
+// Parity is property-tested in tests/test_native.py.
+//
+// Build: make -C native   (or python -m butterfly_tpu.native.build)
+// ABI: plain C (ctypes-friendly), one allocator handle per Scheduler.
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+struct Allocator {
+  int32_t num_pages;          // usable pages (null page excluded)
+  int32_t page_size;          // tokens per page
+  int32_t max_pages_per_seq;  // block-table row width
+  std::vector<int32_t> free_list;          // back = next page handed out
+  std::vector<std::vector<int32_t>> owned; // slot -> page ids, in order
+};
+
+int32_t pages_needed(const Allocator& a, int32_t slot, int32_t new_length) {
+  const int32_t have = static_cast<int32_t>(a.owned[slot].size());
+  const int32_t want = (new_length + a.page_size - 1) / a.page_size;
+  return want > have ? want - have : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle. num_slots bounds the slot index space (the
+// scheduler's max_batch_size); slot ids outside [0, num_slots) are the
+// caller's bug and are range-checked defensively.
+void* bfa_create(int32_t num_pages, int32_t page_size,
+                 int32_t max_pages_per_seq, int32_t num_slots) {
+  if (num_pages < 0 || page_size <= 0 || max_pages_per_seq <= 0 ||
+      num_slots <= 0) {
+    return nullptr;
+  }
+  auto* a = new Allocator();
+  a->num_pages = num_pages;
+  a->page_size = page_size;
+  a->max_pages_per_seq = max_pages_per_seq;
+  a->free_list.reserve(num_pages);
+  for (int32_t p = num_pages - 1; p >= 0; --p) a->free_list.push_back(p);
+  a->owned.resize(num_slots);
+  return a;
+}
+
+void bfa_destroy(void* h) { delete static_cast<Allocator*>(h); }
+
+int32_t bfa_free_pages(void* h) {
+  return static_cast<int32_t>(static_cast<Allocator*>(h)->free_list.size());
+}
+
+// Writes slot's page ids into out (caller sizes it max_pages_per_seq);
+// returns the count.
+int32_t bfa_pages_of(void* h, int32_t slot, int32_t* out) {
+  auto* a = static_cast<Allocator*>(h);
+  if (slot < 0 || slot >= static_cast<int32_t>(a->owned.size())) return 0;
+  const auto& pages = a->owned[slot];
+  for (size_t i = 0; i < pages.size(); ++i) out[i] = pages[i];
+  return static_cast<int32_t>(pages.size());
+}
+
+int32_t bfa_can_grow(void* h, int32_t slot, int32_t new_length) {
+  auto* a = static_cast<Allocator*>(h);
+  if (slot < 0 || slot >= static_cast<int32_t>(a->owned.size())) return 0;
+  if (new_length > a->max_pages_per_seq * a->page_size) return 0;
+  return pages_needed(*a, slot, new_length) <=
+                 static_cast<int32_t>(a->free_list.size())
+             ? 1
+             : 0;
+}
+
+// All-or-nothing grow. Returns the number of freshly allocated pages
+// written to out (possibly 0), or -1 when the request cannot be
+// satisfied (nothing is allocated).
+int32_t bfa_grow(void* h, int32_t slot, int32_t new_length, int32_t* out) {
+  auto* a = static_cast<Allocator*>(h);
+  if (!bfa_can_grow(h, slot, new_length)) return -1;
+  const int32_t n = pages_needed(*a, slot, new_length);
+  auto& mine = a->owned[slot];
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t page = a->free_list.back();
+    a->free_list.pop_back();
+    mine.push_back(page);
+    out[i] = page;
+  }
+  return n;
+}
+
+// Frees all of slot's pages (finish/preempt); returns how many.
+int32_t bfa_release(void* h, int32_t slot) {
+  auto* a = static_cast<Allocator*>(h);
+  if (slot < 0 || slot >= static_cast<int32_t>(a->owned.size())) return 0;
+  auto& pages = a->owned[slot];
+  const int32_t n = static_cast<int32_t>(pages.size());
+  for (auto it = pages.rbegin(); it != pages.rend(); ++it) {
+    a->free_list.push_back(*it);
+  }
+  pages.clear();
+  return n;
+}
+
+}  // extern "C"

@@ -31,6 +31,23 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
     assert len(jax.devices()) == 8, "tests expect 8 fake CPU devices"
+    # Best-effort build of the native runtime lib so tests/test_native.py
+    # and the scheduler's native-allocator path run in CI; rebuilt when
+    # the C++ source is newer than the .so (a stale binary must never be
+    # what the parity tests validate). On failure (no g++) those tests
+    # skip and everything falls back to Python.
+    from pathlib import Path
+    from butterfly_tpu.native import _LIB_PATH
+    src = Path(__file__).parent.parent / "native" / "allocator.cc"
+    stale = (not _LIB_PATH.exists()
+             or (src.exists()
+                 and src.stat().st_mtime > _LIB_PATH.stat().st_mtime))
+    if stale:
+        try:
+            from butterfly_tpu.native.build import build
+            build(verbose=False)
+        except Exception:
+            pass
 
 
 @pytest.fixture(scope="session")
