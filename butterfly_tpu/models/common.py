@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from butterfly_tpu.core.config import ModelConfig
-from butterfly_tpu.quant.int8 import maybe_dequant
+from butterfly_tpu.quant.int8 import qeinsum
 
 Params = Dict[str, Any]
 
@@ -169,9 +169,9 @@ def qkv_proj(x: jax.Array, p: Params, cfg: ModelConfig,
     """QKV projections (+bias, +rope). x: [B,T,D] -> q [B,T,Nq,H],
     k/v [B,T,Kv,H]. Shared by the contiguous and paged attention paths."""
     dt = x.dtype
-    q = jnp.einsum("btd,dnh->btnh", x, maybe_dequant(p["wq"], dt))
-    k = jnp.einsum("btd,dkh->btkh", x, maybe_dequant(p["wk"], dt))
-    v = jnp.einsum("btd,dkh->btkh", x, maybe_dequant(p["wv"], dt))
+    q = qeinsum("btd,dnh->btnh", x, p["wq"], dt)
+    k = qeinsum("btd,dkh->btkh", x, p["wk"], dt)
+    v = qeinsum("btd,dkh->btkh", x, p["wv"], dt)
     if cfg.use_bias:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -184,7 +184,7 @@ def qkv_proj(x: jax.Array, p: Params, cfg: ModelConfig,
 
 def attn_output(out: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     """Output projection of the attention sublayer. out: [B,T,Nq,H]."""
-    out = jnp.einsum("btnh,nhd->btd", out, maybe_dequant(p["wo"], out.dtype))
+    out = qeinsum("btnh,nhd->btd", out, p["wo"], out.dtype)
     if cfg.use_bias:
         out = out + p["bo"]
     return out
@@ -223,15 +223,15 @@ def mlp_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     act = ACTIVATIONS[cfg.act]
     dt = x.dtype
     if cfg.arch == "gpt2":
-        h = jnp.einsum("btd,df->btf", x, maybe_dequant(p["w_up"], dt))
+        h = qeinsum("btd,df->btf", x, p["w_up"], dt)
         h = act(h + p["b_up"])
-        out = jnp.einsum("btf,fd->btd", h, maybe_dequant(p["w_down"], dt))
+        out = qeinsum("btf,fd->btd", h, p["w_down"], dt)
         return out + p["b_down"]
     # llama-style gated SwiGLU
-    g = jnp.einsum("btd,df->btf", x, maybe_dequant(p["w_gate"], dt))
-    u = jnp.einsum("btd,df->btf", x, maybe_dequant(p["w_up"], dt))
+    g = qeinsum("btd,df->btf", x, p["w_gate"], dt)
+    u = qeinsum("btd,df->btf", x, p["w_up"], dt)
     h = act(g) * u
-    return jnp.einsum("btf,fd->btd", h, maybe_dequant(p["w_down"], dt))
+    return qeinsum("btf,fd->btd", h, p["w_down"], dt)
 
 
 def route_tokens(x: jax.Array, router_w: jax.Array,
@@ -260,10 +260,10 @@ def moe_block(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
 
     act = ACTIVATIONS[cfg.act]
     dt = x.dtype
-    g = jnp.einsum("btd,edf->ebtf", x, maybe_dequant(p["w_gate"], dt))
-    u = jnp.einsum("btd,edf->ebtf", x, maybe_dequant(p["w_up"], dt))
+    g = qeinsum("btd,edf->ebtf", x, p["w_gate"], dt)
+    u = qeinsum("btd,edf->ebtf", x, p["w_up"], dt)
     h = act(g) * u
-    y = jnp.einsum("ebtf,efd->ebtd", h, maybe_dequant(p["w_down"], dt))
+    y = qeinsum("ebtf,efd->ebtd", h, p["w_down"], dt)
     return jnp.einsum("ebtd,bte->btd", y, comb.astype(y.dtype))
 
 
@@ -368,9 +368,81 @@ def final_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
         logits = jnp.einsum("btd,vd->btv", x,
                             params["embed"]["tok"].astype(compute_dtype))
     else:
-        logits = jnp.einsum("btd,dv->btv", x,
-                            maybe_dequant(params["lm_head"], compute_dtype))
+        logits = qeinsum("btd,dv->btv", x, params["lm_head"], compute_dtype)
     return logits.astype(jnp.float32)
+
+
+def decode_attend(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                  ck: jax.Array, cv: jax.Array, start: jax.Array,
+                  cfg: ModelConfig) -> jax.Array:
+    """One-token attention over (old cache) + (the token itself).
+
+    The general path writes K/V into the cache BEFORE attending, which
+    forces a per-layer scattered cache update inside the layer scan — 2L
+    batched-dynamic-slice scatters per decode step, the dominant cost of
+    the decode loop at serving batch sizes (measured on v5e). Attending
+    over the unmodified cache (positions < start, no write yet) plus an
+    explicit self-attention term is mathematically identical for causal
+    decode and lets the caller write ALL layers' new K/V in one batched
+    update after the scan (see _decode_forward).
+
+    q [B,1,Nq,H]; k_new/v_new [B,1,Kv,H]; ck/cv [B,S,Kv,H]; start [B].
+    """
+    B, _, Nq, H = q.shape
+    S = ck.shape[1]
+    Kv = k_new.shape[2]
+    G = Nq // Kv
+    qg = q.reshape(B, Kv, G, H)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(H, jnp.float32))
+    s_c = jnp.einsum("bkgh,bskh->bkgs", qg, ck,
+                     preferred_element_type=jnp.float32) * scale
+    older = jnp.arange(S)[None, :] < start[:, None]          # strictly past
+    s_c = jnp.where(older[:, None, None, :], s_c, -1e30)
+    s_self = jnp.sum(qg.astype(jnp.float32) *
+                     k_new.reshape(B, Kv, 1, H).astype(jnp.float32),
+                     axis=-1, keepdims=True) * scale          # [B,Kv,G,1]
+    s = jnp.concatenate([s_c, s_self], axis=-1)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bskh->bkgh", p[..., :S].astype(cv.dtype), cv)
+    out = out + p[..., S:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)
+    return out.reshape(B, 1, Nq, H)
+
+
+def _decode_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                    cache: KVCache, positions: jax.Array
+                    ) -> Tuple[jax.Array, KVCache]:
+    """Single-token decode step with ONE batched cache write.
+
+    The layer scan attends via decode_attend (old cache + self term) and
+    emits each layer's fresh K/V as stacked scan outputs; the cache is
+    then updated for every layer at once with a single vmapped
+    dynamic-update-slice — O(1) update ops per step instead of O(L).
+    """
+    B = tokens.shape[0]
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    start = positions[:, 0]
+    compute_dtype = jnp.dtype(cfg.dtype)
+
+    # scan reads each layer's cache slice as an input (no carry update)
+    def layer(x, scanned):
+        lp, ck, cv = scanned
+        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
+        h = pre_norm(x, lp["ln1"], cfg)
+        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+        out = decode_attend(q, k, v, ck, cv, start, cfg)
+        x = x + attn_output(out, lp["attn"], cfg)
+        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        return x, (k.astype(ck.dtype), v.astype(cv.dtype))
+
+    x, (ks, vs) = lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+
+    def upd(c_b, n_b, s_b):  # [L,S,Kv,H] <- [L,1,Kv,H] at (0, s_b, 0, 0)
+        return lax.dynamic_update_slice(c_b, n_b, (0, s_b, 0, 0))
+
+    new_k = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.k, ks, start)
+    new_v = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.v, vs, start)
+    logits = final_logits(params, cfg, x)
+    return logits, KVCache(new_k, new_v, cache.length + 1)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
@@ -381,11 +453,15 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     positions defaults to cache.length[:,None] + arange(T) (append).
     `fresh` (static) = the cache is empty and positions start at 0; only
     then may the flash prefill kernel be used (see attention_block).
-    Returns (logits [B,T,V] float32, updated cache).
+    Single-token warm calls take the decode fast path (_decode_forward:
+    deferred one-shot cache write). Returns (logits [B,T,V] float32,
+    updated cache).
     """
     B, T = tokens.shape
     if positions is None:
         positions = cache.length[:, None] + jnp.arange(T)[None, :]
+    if T == 1 and not fresh:
+        return _decode_forward(params, cfg, tokens, cache, positions)
 
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)

@@ -80,10 +80,20 @@ class NativePageAllocator:
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         self._buf = (ctypes.c_int32 * max(1, max_pages_per_seq))()
+        self._num_slots = num_slots
         self._h = lib.bfa_create(num_pages, page_size, max_pages_per_seq,
                                  num_slots)
         if not self._h:
             raise ValueError("invalid allocator parameters")
+
+    def _check_slot(self, slot: int) -> None:
+        # The C side range-checks defensively (refuses silently); the
+        # Python fallback is an unbounded dict — raise here so an
+        # out-of-range slot is a loud caller bug on BOTH backends
+        # instead of backend-dependent starvation.
+        if not 0 <= slot < self._num_slots:
+            raise IndexError(
+                f"slot {slot} out of range [0, {self._num_slots})")
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -96,6 +106,7 @@ class NativePageAllocator:
         return int(self._lib.bfa_free_pages(self._h))
 
     def pages_of(self, slot: int) -> List[int]:
+        self._check_slot(slot)
         n = self._lib.bfa_pages_of(self._h, slot, self._buf)
         return list(self._buf[:n])
 
@@ -105,15 +116,18 @@ class NativePageAllocator:
         return max(0, want - have)
 
     def can_grow(self, slot: int, new_length: int) -> bool:
+        self._check_slot(slot)
         return bool(self._lib.bfa_can_grow(self._h, slot, new_length))
 
     def grow(self, slot: int, new_length: int) -> Optional[List[int]]:
+        self._check_slot(slot)
         n = self._lib.bfa_grow(self._h, slot, new_length, self._buf)
         if n < 0:
             return None
         return list(self._buf[:n])
 
     def release(self, slot: int) -> List[int]:
+        self._check_slot(slot)
         pages = self.pages_of(slot)
         self._lib.bfa_release(self._h, slot)
         return pages

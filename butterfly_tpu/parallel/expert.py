@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from butterfly_tpu.core.config import ModelConfig
 from butterfly_tpu.models.common import ACTIVATIONS, Params
+from butterfly_tpu.quant.int8 import qeinsum
 
 
 def _constrain(x: jax.Array, spec: P) -> jax.Array:
@@ -122,9 +123,9 @@ def _moe_ep_einsum(x: jax.Array, p: Params, cfg: ModelConfig,
     xin = _constrain(xin, P("expert", "data", None, None))
 
     act = ACTIVATIONS[cfg.act]
-    g = jnp.einsum("ebcd,edf->ebcf", xin, p["w_gate"])
-    u = jnp.einsum("ebcd,edf->ebcf", xin, p["w_up"])
-    y = jnp.einsum("ebcf,efd->ebcd", act(g) * u, p["w_down"])
+    g = qeinsum("ebcd,edf->ebcf", xin, p["w_gate"])
+    u = qeinsum("ebcd,edf->ebcf", xin, p["w_up"])
+    y = qeinsum("ebcf,efd->ebcd", act(g) * u, p["w_down"])
     y = _constrain(y, P("expert", "data", None, None))
 
     # Reverse all-to-all + weighted combine back to token-major layout.
@@ -197,9 +198,9 @@ def _a2a_body(x, p, *, cfg: ModelConfig, N: int, ne: int, C: int):
     recv = lax.all_to_all(send, "expert", 0, 0, tiled=True)  # [N,ne,C,D]
     xin = recv.transpose(1, 0, 2, 3).reshape(ne, N * C, D)
     act = ACTIVATIONS[cfg.act]
-    gg = jnp.einsum("ecd,edf->ecf", xin, p["w_gate"])
-    uu = jnp.einsum("ecd,edf->ecf", xin, p["w_up"])
-    y = jnp.einsum("ecf,efd->ecd", act(gg) * uu, p["w_down"])
+    gg = qeinsum("ecd,edf->ecf", xin, p["w_gate"])
+    uu = qeinsum("ecd,edf->ecf", xin, p["w_up"])
+    y = qeinsum("ecf,efd->ecd", act(gg) * uu, p["w_down"])
     y = y.reshape(ne, N, C, D).transpose(1, 0, 2, 3)
     y_back = lax.all_to_all(y, "expert", 0, 0, tiled=True)   # [N,ne,C,D]
 

@@ -2,18 +2,21 @@
 
 Decode throughput on TPU is HBM-bound: every step streams the full weight
 tree (SURVEY.md §6; VERDICT.md round-1 roofline ~29% of v5e bandwidth).
-Symmetric per-output-channel int8 halves the streamed bytes vs bfloat16;
-XLA fuses the int8->bf16 convert + scale multiply into the matmul operand
-read, so no dequantized copy ever materializes in HBM (verified by a
-marginal-bandwidth probe on v5e).
+Symmetric per-output-channel int8 halves the streamed bytes vs bfloat16.
 
 Scheme: for each matmul weight W with contraction axes C,
     scale = absmax(W, over C) / 127        (keepdims, float32)
     q8    = round(W / scale)               (int8)
     W ~= q8 * scale
-Per-output-channel scales commute with the contraction, so
-`x @ (q8 * s) == (x @ q8_as_bf16) * s` — the forward dequantizes lazily
-via `maybe_dequant` and XLA folds it into the einsum.
+
+The forward NEVER computes `q8 * s` as a matmul operand: XLA fuses a
+bare int8->bf16 convert into the dot's operand read, but an operand
+*multiply* does not fold — it materializes the full dequantized tree in
+HBM every step (measured on v5e: the 1B bench decode step streamed
+~5.3GB instead of ~1.5GB, 26% roofline). Per-output-channel scales
+commute with the contraction, so `qeinsum` computes
+`einsum(x, q8.astype(bf16)) * s_out` — scale applied to the (tiny)
+matmul OUTPUT — and only the int8 bytes ever cross HBM.
 
 Quantized leaves are `{"q8": int8, "s": float32}` sub-dicts replacing the
 original array; everything numerically delicate (embeddings, norms,
@@ -44,12 +47,38 @@ def tree_is_quantized(params: Params) -> bool:
 def maybe_dequant(w: Any, dtype) -> jax.Array:
     """Dequantize a `{"q8","s"}` leaf to `dtype`; pass arrays through.
 
-    The convert+multiply fuses into the consuming matmul's operand read
-    on TPU — call this directly inside the einsum expression.
+    NB: using this as a matmul operand materializes the dequantized
+    array (the scale multiply doesn't fold into the dot) — matmul call
+    sites must use `qeinsum` instead; this exists for non-matmul uses
+    and debugging.
     """
     if is_quantized_leaf(w):
         return w["q8"].astype(dtype) * w["s"].astype(dtype)
     return w
+
+
+def qeinsum(spec: str, x: jax.Array, w: Any,
+            dtype: Optional[Any] = None) -> jax.Array:
+    """einsum(spec, x, W) for a possibly-quantized right operand W.
+
+    Quantized: contracts x against the raw int8 codes (the int8->dtype
+    convert fuses into the dot's operand read — only int8 bytes stream
+    from HBM) and applies the per-output-channel scale to the OUTPUT.
+    Valid because the scale has size-1 contraction dims (keepdims), so
+    it commutes with the contraction: x @ (q8*s) == (x @ q8) * s. The
+    output-shaped scale is derived by running the same einsum spec over
+    an all-ones x surrogate (every dim 1) and the scale — shape algebra
+    only; it broadcasts over the batch dims of the real output.
+    """
+    dtype = dtype or x.dtype
+    if not is_quantized_leaf(w):
+        if jnp.issubdtype(w.dtype, jnp.floating) and w.dtype != dtype:
+            w = w.astype(dtype)  # master-dtype leaves compute in `dtype`
+        return jnp.einsum(spec, x, w)
+    y = jnp.einsum(spec, x, w["q8"].astype(dtype))
+    ones = jnp.ones((1,) * x.ndim, dtype)
+    s_out = jnp.einsum(spec, ones, w["s"].astype(dtype))
+    return y * s_out
 
 
 def _quant(w: jax.Array, axes: Tuple[int, ...], dtype) -> Dict[str, jax.Array]:
