@@ -101,12 +101,29 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       q_pos: jax.Array, axis_name: str = "seq") -> jax.Array:
     """All-to-all head<->sequence reshard + local full causal attention.
 
-    q: [B, T/N, Nq, H]; k/v: [B, T/N, Kv, H]. Needs Nq % N == 0 and
-    Kv % N == 0. Returns [B, T/N, Nq, H].
+    q: [B, T/N, Nq, H]; k/v: [B, T/N, Kv, H]. Needs Nq % N == 0; when
+    Kv < N (realistic GQA, e.g. Llama-3 Kv=8 on a 16-way seq axis) and
+    N % Kv == 0, KV heads are REPLICATED r = N/Kv times before the
+    all_to_all so device d receives the kv head (d // r) its q-head
+    block contracts with — the seq axis is no longer capped at Kv, at
+    the cost of r x the K/V all_to_all volume. Returns [B, T/N, Nq, H].
     """
     from butterfly_tpu.models.common import attend
     N = lax.axis_size(axis_name)
     B, Tl, Nq, H = q.shape
+    Kv = k.shape[2]
+    if Kv % N != 0:
+        if N % Kv != 0 or Nq % N != 0:
+            raise ValueError(
+                f"ulysses needs Kv % N == 0 or (N % Kv == 0 and "
+                f"Nq % N == 0); got Nq={Nq}, Kv={Kv}, N={N}")
+        # head replication: q heads [d*Nq/N, (d+1)*Nq/N) all map to kv
+        # head d // r (block size Nq/N divides the GQA group G = Nq/Kv
+        # because Kv < N), so repeating each kv head r times puts the
+        # right copy on every device after the head-scatter.
+        r = N // Kv
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
     # heads scatter (axis 2), sequence gathers (axis 1)
     qq = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kk = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
