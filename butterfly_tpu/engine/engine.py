@@ -45,7 +45,8 @@ class InferenceEngine:
 
     def __init__(self, model: Model, params, runtime: Optional[RuntimeConfig] = None,
                  mesh=None, num_microbatches: Optional[int] = None,
-                 use_flash_prefill: Optional[bool] = None):
+                 use_flash_prefill: Optional[bool] = None,
+                 virtual_stages: int = 1):
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
@@ -54,6 +55,21 @@ class InferenceEngine:
         # (the in-scan cast then no-ops and XLA elides it).
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
+        S = mesh.shape.get("stage", 1) if mesh is not None else 1
+        if virtual_stages > 1 and S > 1:
+            # interleaved 1F1B-style schedule: permute the layer stack
+            # once so each stage's contiguous shard holds its V
+            # round-robin chunks (parallel/pipeline.py). Donating jit:
+            # no transient second copy of the stack in HBM.
+            from butterfly_tpu.parallel.pipeline import interleave_layers
+            perm = jax.jit(
+                partial(interleave_layers, num_layers=self.cfg.num_layers,
+                        S=S, V=virtual_stages),
+                donate_argnums=(0,))
+            self.params = dict(self.params)
+            self.params["layers"] = perm(self.params["layers"])
+        elif S <= 1:
+            virtual_stages = 1  # no stage axis: schedule knob is moot
         if use_flash_prefill is None:
             # Pallas kernels are TPU-only; under a mesh the call sites go
             # through ops/*_sharded (shard_map over data/tensor), so a
@@ -68,7 +84,8 @@ class InferenceEngine:
             if mesh is not None and mesh.shape.get("stage", 1) > 1:
                 from butterfly_tpu.parallel.pipeline import pipeline_forward
                 return lambda p, t, c, pos=None: pipeline_forward(
-                    p, cfg, t, c, mesh, num_microbatches, pos, fresh=fresh)
+                    p, cfg, t, c, mesh, num_microbatches, pos, fresh=fresh,
+                    virtual_stages=virtual_stages)
             return lambda p, t, c, pos=None: forward(p, cfg, t, c, pos,
                                                      fresh=fresh)
 

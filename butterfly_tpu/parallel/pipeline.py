@@ -39,7 +39,8 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
                      cache: KVCache, mesh: Mesh,
                      num_microbatches: Optional[int] = None,
                      positions: Optional[jax.Array] = None,
-                     fresh: bool = False
+                     fresh: bool = False,
+                     virtual_stages: int = 1
                      ) -> Tuple[jax.Array, KVCache]:
     """Full forward with the layer stack pipelined over `stage`.
 
@@ -47,6 +48,15 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     stage loop; on a real pod they live with stage 0 / stage S-1 layer
     weights — replicated here, cheap relative to the stack). Requires
     cfg.num_layers % S == 0 and batch % num_microbatches == 0.
+
+    virtual_stages V > 1 selects the INTERLEAVED schedule (SURVEY.md §7
+    stage 3 "interleaved 1F1B-style decode"): each device owns V
+    round-robin layer chunks and activations make V trips around a
+    wrapping ppermute ring, cutting the bubble from (S-1)/(M+S-1) to
+    (S-1)/(V*M+S-1) — the decode-latency win when M can't be large.
+    Params/cache must then be in interleaved layer order (one-time
+    permutation via `interleave_layers`), and M >= S so wrapped
+    activations arrive before they're consumed.
     """
     S = mesh.shape["stage"]
     B, T = tokens.shape
@@ -61,16 +71,58 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     if cfg.num_layers % S != 0:
         raise ValueError(f"{cfg.num_layers} layers not divisible by {S} stages")
+    V = virtual_stages
+    if V > 1:
+        if cfg.num_layers % (S * V) != 0:
+            raise ValueError(f"{cfg.num_layers} layers not divisible by "
+                             f"{S} stages x {V} virtual chunks")
+        if M < S:
+            raise ValueError(
+                f"interleaved schedule needs microbatches >= stages "
+                f"({M} < {S}): a wrapped activation produced at tick "
+                f"t reaches stage 0 at t+1 but is consumed at t+M-S+1")
 
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)
 
-    body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh)
+    if V > 1:
+        body = partial(_interleaved_body, cfg=cfg, S=S, M=M, V=V,
+                       fresh=fresh)
+    else:
+        body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh)
     y, (new_k, new_v) = _run_gpipe(body, mesh, params["layers"],
                                    (cache.k, cache.v),
                                    (x, positions, mask, cos, sin), S, M, x)
     logits = final_logits(params, cfg, y)
     return logits, KVCache(new_k, new_v, cache.length + T)
+
+
+def interleave_layers(tree, num_layers: int, S: int, V: int,
+                      inverse: bool = False):
+    """Permute stacked-L leaves into (or back out of) interleaved order.
+
+    Interleaved pipeline layout: stage s's contiguous [L/S] block holds
+    the round-robin chunks v*S + s for v in 0..V-1, so shard_map's
+    P('stage') on the L dim gives each stage exactly its interleaved
+    chunks. Apply ONCE at weight-load/cache-init — not per step.
+    Leaves whose leading dim != num_layers are passed through.
+    """
+    import numpy as np
+    Lc = num_layers // (S * V)
+    order = np.asarray([(v * S + s) * Lc + i
+                        for s in range(S) for v in range(V)
+                        for i in range(Lc)])
+    if inverse:
+        inv = np.empty_like(order)
+        inv[order] = np.arange(num_layers)
+        order = inv
+
+    def perm(a):
+        if hasattr(a, "shape") and a.ndim >= 1 and a.shape[0] == num_layers:
+            return jnp.take(a, jnp.asarray(order), axis=0)
+        return a
+
+    return jax.tree.map(perm, tree)
 
 
 def _run_gpipe(body, mesh: Mesh, layers, stage_ops, rep_ops, S: int, M: int,
@@ -223,6 +275,89 @@ def _paged_pipeline_body(layers, k_pages, v_pages, x, page_table, positions,
 
     outs, (kp, vp) = _gpipe_schedule(S, M, xs, step, (k_pages, v_pages))
     return outs, kp, vp
+
+
+def _interleaved_body(layers, ck, cv, x, positions, mask, cos, sin,
+                      *, cfg: ModelConfig, S: int, M: int, V: int,
+                      fresh: bool = False):
+    """Interleaved virtual-stage schedule (manual over stage).
+
+    Work unit w = v*M + m: chunk v of microbatch m. Tick t has stage s
+    on w = t - s; V*M + S - 1 ticks total. The ppermute ring WRAPS
+    (S-1 -> 0): a microbatch leaving the last stage's chunk v re-enters
+    stage 0 for chunk v+1. Early wrapped arrivals (they land after one
+    hop but are consumed M-S+1 ticks later) sit in a per-microbatch
+    buffer on stage 0.
+    """
+    B = x.shape[0]
+    mb = B // M
+    Lc = ck.shape[0] // V  # local layers per virtual chunk
+
+    xs = x.reshape(M, mb, *x.shape[1:])
+    pos_mb = positions.reshape(M, mb, *positions.shape[1:])
+    mask_mb = mask.reshape(M, mb, *mask.shape[1:])
+    cos_mb = cos.reshape(M, mb, *cos.shape[1:])
+    sin_mb = sin.reshape(M, mb, *sin.shape[1:])
+
+    layers_v = jax.tree.map(lambda a: a.reshape(V, Lc, *a.shape[1:]), layers)
+    ck_v = ck.reshape(V, Lc, *ck.shape[1:])
+    cv_v = cv.reshape(V, Lc, *cv.shape[1:])
+
+    stage = lax.axis_index("stage")
+    state0 = jnp.zeros_like(xs[0])
+    buf0 = jnp.zeros_like(xs)     # stage-0 holding pen for wrapped states
+    out0 = jnp.zeros_like(xs)
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(c, t):
+        state, buf, ckv, cvv, outs = c
+
+        # bank the state that just wrapped onto stage 0 (produced by the
+        # last stage at t-1 with work index t-S; destined for chunk
+        # (t-S)//M + 1 of microbatch (t-S)%M)
+        w_in = t - S
+        keep_in = (stage == 0) & (w_in >= 0) & (w_in < V * M - M)
+        m_in = jnp.clip(w_in, 0, V * M - 1) % M
+        banked = lax.dynamic_update_index_in_dim(buf, state, m_in, 0)
+        buf = jnp.where(keep_in, banked, buf)
+
+        w = t - stage
+        valid = (w >= 0) & (w < V * M)
+        wc = jnp.clip(w, 0, V * M - 1)
+        v = wc // M
+        m = wc % M
+
+        inj = jnp.where(v == 0, xs[m], buf[m])
+        inp = jnp.where(stage == 0, inj, state)
+
+        lyr = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            layers_v)
+        ck_c = lax.dynamic_index_in_dim(ckv, v, 0, keepdims=False)
+        cv_c = lax.dynamic_index_in_dim(cvv, v, 0, keepdims=False)
+        ck_m = lax.dynamic_slice_in_dim(ck_c, m * mb, mb, axis=1)
+        cv_m = lax.dynamic_slice_in_dim(cv_c, m * mb, mb, axis=1)
+
+        y, nk, nv = scan_layers(lyr, cfg, inp, ck_m, cv_m,
+                                pos_mb[m], mask_mb[m], cos_mb[m],
+                                sin_mb[m], fresh)
+
+        nk = jnp.where(valid, nk, ck_m)
+        nv = jnp.where(valid, nv, cv_m)
+        ck_c = lax.dynamic_update_slice_in_dim(ck_c, nk, m * mb, axis=1)
+        cv_c = lax.dynamic_update_slice_in_dim(cv_c, nv, m * mb, axis=1)
+        ckv = lax.dynamic_update_index_in_dim(ckv, ck_c, v, 0)
+        cvv = lax.dynamic_update_index_in_dim(cvv, cv_c, v, 0)
+
+        rec = jnp.where(valid & (stage == S - 1) & (v == V - 1), y, outs[m])
+        outs = lax.dynamic_update_index_in_dim(outs, rec, m, 0)
+        state = lax.ppermute(y, "stage", ring)
+        return (state, buf, ckv, cvv, outs), None
+
+    (_, _, ckv, cvv, outs), _ = lax.scan(
+        tick, (state0, buf0, ck_v, cv_v, out0),
+        jnp.arange(V * M + S - 1))
+    return outs, ckv.reshape(ck.shape), cvv.reshape(cv.shape)
 
 
 def _default_microbatches(B: int, S: int) -> int:

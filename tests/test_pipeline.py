@@ -108,6 +108,68 @@ def test_pipeline_stage1_fallback():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_interleaved_pipeline_parity():
+    """Virtual-stage (1F1B-style) schedule: S=2 stages x V=2 chunks,
+    wrapped ppermute ring + stage-0 holding buffer — logits and cache
+    must match the plain forward exactly (prefill then decode steps)."""
+    from butterfly_tpu.parallel.pipeline import interleave_layers
+    cfg = pp_cfg(num_layers=8)
+    mesh = make_mesh(MeshConfig(stage=2, tensor=4))
+    S, V, M = 2, 2, 2
+    params = Model(cfg).init(jax.random.PRNGKey(4))
+    tokens = jnp.asarray(
+        np.random.RandomState(4).randint(0, cfg.vocab_size, (4, 10)))
+    ref_logits, ref_cache = ref_forward(cfg, params, tokens)
+
+    iparams = dict(params)
+    iparams["layers"] = interleave_layers(params["layers"],
+                                          cfg.num_layers, S, V)
+    sparams = shard_params(iparams, cfg, mesh)
+    cache = shard_cache(init_cache(cfg, batch=4, max_seq=32), cfg, mesh)
+    step = jax.jit(lambda p, t, c: pipeline_forward(
+        p, cfg, t, c, mesh, num_microbatches=M, virtual_stages=V))
+    with jax.set_mesh(mesh):
+        logits, cache = step(sparams, tokens, cache)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-5, atol=2e-5)
+    k_back = interleave_layers(cache.k, cfg.num_layers, S, V, inverse=True)
+    np.testing.assert_allclose(np.asarray(k_back), np.asarray(ref_cache.k),
+                               rtol=2e-5, atol=2e-5)
+
+    # decode continuation through the interleaved schedule
+    for _ in range(2):
+        nxt = jnp.argmax(ref_logits[:, -1, :], axis=-1)[:, None]
+        ref_logits, ref_cache = jax.jit(
+            lambda p, t, c: forward(p, cfg, t, c))(params, nxt, ref_cache)
+        with jax.set_mesh(mesh):
+            logits, cache = step(sparams, nxt, cache)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(ref_logits),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_interleaved_pipeline_validation():
+    from butterfly_tpu.parallel.pipeline import interleave_layers
+    cfg = pp_cfg(num_layers=8)
+    mesh = make_mesh(MeshConfig(stage=2, tensor=4))
+    params = shard_params(Model(cfg).init(jax.random.PRNGKey(0)), cfg, mesh)
+    cache = shard_cache(init_cache(cfg, batch=4, max_seq=16), cfg, mesh)
+    tokens = jnp.zeros((4, 4), jnp.int32)
+    with pytest.raises(ValueError, match="microbatches >= stages"):
+        pipeline_forward(params, cfg, tokens, cache, mesh,
+                         num_microbatches=1, virtual_stages=2)
+    cfg6 = pp_cfg(num_layers=6)
+    with pytest.raises(ValueError, match="virtual"):
+        pipeline_forward(params, cfg6, tokens, cache, mesh,
+                         num_microbatches=2, virtual_stages=2)
+    # round-trip permutation sanity
+    import numpy as _np
+    arr = jnp.arange(8)
+    back = interleave_layers(
+        interleave_layers(arr, 8, 2, 2), 8, 2, 2, inverse=True)
+    _np.testing.assert_array_equal(_np.asarray(back), _np.arange(8))
+
+
 def test_pipeline_no_full_output_allreduce():
     """VERDICT r2 item 5: the pipeline's output must come off the last
     stage as ONE block move (collective-permute / gather of [B,T,D]),
@@ -161,6 +223,23 @@ def test_pipeline_validation_errors():
     with pytest.raises(ValueError, match="layers"):
         pipeline_forward(params, cfg6, tokens, cache, mesh,
                          num_microbatches=2)
+
+
+def test_engine_generate_interleaved_stages():
+    """Engine integration: virtual_stages=2 on a stage=2 mesh permutes
+    the layer stack once and generates the same tokens as unmeshed."""
+    from butterfly_tpu.engine import InferenceEngine, SamplingParams
+    cfg = pp_cfg(num_layers=8)
+    mesh = make_mesh(MeshConfig(stage=2, tensor=4))
+    params = shard_params(Model(cfg).init(jax.random.PRNGKey(5)), cfg, mesh)
+    engine = InferenceEngine(Model(cfg), params, mesh=mesh,
+                             num_microbatches=2, virtual_stages=2)
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]] + [[2]]
+    res = engine.generate(prompts, SamplingParams(max_new_tokens=5))
+    ref = InferenceEngine(Model(cfg),
+                          Model(cfg).init(jax.random.PRNGKey(5))).generate(
+        prompts, SamplingParams(max_new_tokens=5))
+    np.testing.assert_array_equal(res.tokens, ref.tokens)
 
 
 def test_engine_generate_on_pp_mesh_odd_batch():
