@@ -29,7 +29,8 @@ from butterfly_tpu.obs.metrics import ThroughputWindow, render_prometheus
 
 
 class ServerState:
-    def __init__(self, scheduler, tokenizer, max_queue: int = 256):
+    def __init__(self, scheduler, tokenizer, max_queue: int = 256,
+                 heartbeat=None):
         self.sched = scheduler
         self.tok = tokenizer
         self.lock = threading.Lock()       # guards scheduler state
@@ -40,6 +41,39 @@ class ServerState:
         self.t_start = time.monotonic()
         self.error: str = ""               # set => serving is wedged: 503s
         self.thread = threading.Thread(target=self._loop, daemon=True)
+        # Optional HeartbeatMonitor (obs/health.py): the scheduler
+        # thread beats after every tick and runs the probe in-thread
+        # when idle (JAX stays on ONE host thread); the monitor's
+        # watchdog thread only watches wall-clock staleness, so a HUNG
+        # tick latches too. On latch: wedge serving (503s) and drain
+        # host-side only (abort_all never touches the dead device).
+        self.heartbeat = heartbeat
+        if heartbeat is not None:
+            prev = heartbeat.on_failure
+            if prev is None:
+                heartbeat.on_failure = self._on_heartbeat_failure
+            else:  # chain a caller-provided hook, don't discard it
+                def chained(exc, _prev=prev):
+                    self._on_heartbeat_failure(exc)
+                    _prev(exc)
+                heartbeat.on_failure = chained
+            if not heartbeat._thread.is_alive():
+                heartbeat.start()
+
+    def _on_heartbeat_failure(self, exc) -> None:
+        # Runs on the watchdog thread: host-only bookkeeping, no JAX.
+        # In the hung-tick scenario the scheduler thread HOLDS self.lock
+        # (stuck inside a device call) — waiting on it would deadlock
+        # the very recovery this exists for. Try briefly, then drain
+        # without it: a thread hung in XLA isn't mutating scheduler
+        # host state, and abort_all is idempotent host bookkeeping.
+        self.error = f"heartbeat failed: {self.heartbeat.last_error}"
+        got = self.lock.acquire(timeout=2.0)
+        try:
+            self.sched.abort_all()
+        finally:
+            if got:
+                self.lock.release()
 
     # -- scheduler thread ----------------------------------------------------
 
@@ -59,7 +93,11 @@ class ServerState:
             if has_work:
                 if made:
                     self.throughput.record(made)
+                if self.heartbeat is not None:
+                    self.heartbeat.beat()  # a completed tick IS liveness
             else:
+                if self.heartbeat is not None:
+                    self.heartbeat.maybe_probe()  # idle: probe in-thread
                 self.wake.wait(timeout=0.05)
                 self.wake.clear()
 
@@ -75,6 +113,10 @@ class ServerState:
             q.put(None)  # completion sentinel (after the last on_token)
 
         with self.lock:
+            # re-check under the lock: the heartbeat may have wedged the
+            # server between the handler's check and this admission
+            if self.error:
+                raise RuntimeError("server wedged: " + self.error)
             if len(self.sched.waiting) >= self.max_queue:
                 return None, None
             req = self.sched.submit(tokens, max_new_tokens=max_tokens,
@@ -109,11 +151,14 @@ def make_handler(state: ServerState):
 
         def do_GET(self):
             if self.path == "/health":
-                if state.error:
+                if state.error:  # incl. heartbeat latch (on_failure sets it)
                     self._json(503, {"status": "error",
                                      "detail": state.error})
                 else:
-                    self._json(200, {"status": "ok"})
+                    body = {"status": "ok"}
+                    if state.heartbeat is not None:
+                        body["heartbeats"] = state.heartbeat.beats
+                    self._json(200, body)
             elif self.path == "/metrics":
                 body = state.metrics_text().encode()
                 self.send_response(200)
@@ -166,6 +211,9 @@ def make_handler(state: ServerState):
                 req, q = state.submit(tokens, max_tokens, temperature, stop)
             except ValueError as e:  # can never fit the page pool
                 self._json(400, {"error": str(e)})
+                return
+            except RuntimeError as e:  # wedged while we were admitting
+                self._json(503, {"error": str(e)})
                 return
             if req is None:
                 self._json(429, {"error": "queue full"})
@@ -247,9 +295,23 @@ def make_handler(state: ServerState):
 
 def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
                   port: int = 8000, max_queue: int = 256,
-                  ready_event: Optional[threading.Event] = None):
-    """Blocking serve loop. `ready_event` is set once listening (tests)."""
-    state = ServerState(scheduler, tokenizer, max_queue)
+                  ready_event: Optional[threading.Event] = None,
+                  heartbeat=None):
+    """Blocking serve loop. `ready_event` is set once listening (tests).
+
+    `heartbeat`: a HeartbeatMonitor to use (callers may tune interval /
+    misses / probe); default builds one with the local device probe, or
+    the all-hosts psum probe when the job spans multiple processes so a
+    dead peer is detected even while idle.
+    """
+    import jax
+    from butterfly_tpu.obs.health import (
+        HeartbeatMonitor, all_hosts_probe)
+    if heartbeat is None:
+        probe = all_hosts_probe if jax.process_count() > 1 else None
+        heartbeat = HeartbeatMonitor(probe=probe)
+    state = ServerState(scheduler, tokenizer, max_queue,
+                        heartbeat=heartbeat)
     state.thread.start()
     httpd = ThreadingHTTPServer((host, port), make_handler(state))
     state.httpd = httpd
@@ -259,6 +321,8 @@ def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
         httpd.serve_forever()
     finally:
         state.stop.set()
+        if state.heartbeat is not None:
+            state.heartbeat.stop()
         httpd.server_close()
     return 0
 
@@ -281,6 +345,16 @@ def run_server(args) -> int:
                        max_queue=args.max_queue)
     engine = ServingEngine(model, params, rt, mesh=mesh)
     sched = Scheduler(engine)
+    # Warm the serving programs (fresh-chunk prefill, warm-chunk
+    # continuation, batched decode) before listening: the first user
+    # doesn't pay 20-40s of XLA compile, and the heartbeat watchdog
+    # never mistakes the startup compile for a dead device.
+    print("[butterfly] warming serving programs...", flush=True)
+    warm_len = min(2 * rt.prefill_chunk, rt.max_seq_len - 4)
+    warms = [sched.submit([1] * max(1, warm_len), max_new_tokens=2),
+             sched.submit([1], max_new_tokens=2)]  # smallest bucket too
+    sched.run_until_done()
+    assert all(w.done for w in warms)
     mesh_desc = "" if mesh is None else \
         " mesh=" + "x".join(f"{k}{v}" for k, v in mesh.shape.items() if v > 1)
     print(f"[butterfly] serving {args.model} on {args.host}:{args.port} "

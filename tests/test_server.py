@@ -146,7 +146,10 @@ def test_scheduler_crash_degrades_health():
     assert q.get(timeout=10) is None        # sentinel: waiter unblocked
     assert req.state == "cancelled"
     assert "device on fire" in state.error
-    assert state.submit([1], 2, 0.0, -1) != (None, None)  # queued but...
+    # wedged: further admissions are rejected loudly (handler -> 503),
+    # never queued onto the presumed-dead device
+    with pytest.raises(RuntimeError, match="wedged"):
+        state.submit([1], 2, 0.0, -1)
     state.stop.set()
 
 
