@@ -48,25 +48,35 @@ def device_probe() -> bool:
 def all_hosts_probe() -> bool:
     """Prove every process in the job still participates in collectives.
 
-    psum(1) over all devices: if any peer host died, the collective
-    stalls — the watchdog then latches on beat staleness.
+    psum(1) over all devices. This IS a collective: every process must
+    invoke it at the same point in its program stream, so it belongs in
+    COORDINATED contexts (startup bringup checks, synchronized drain
+    points, test harnesses) — never in per-host idle timers, where
+    unsynchronized issue order would desync the SPMD stream and wedge
+    the job (the serving loop uses device_probe per host instead; a
+    dead peer surfaces as the next tick stalling -> staleness latch).
     Single-process: equivalent to device_probe.
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
 
     global _HOSTS_PROBE
-    ndev = len(jax.devices())
+    ndev = jax.device_count()
     if _HOSTS_PROBE is None or _HOSTS_PROBE[1] != ndev:
         mesh = Mesh(np.asarray(jax.devices()), ("all",))
         fn = jax.jit(jax.shard_map(
             lambda x: jax.lax.psum(x, "all"), mesh=mesh,
             in_specs=P("all"), out_specs=P(), check_vma=False))
-        _HOSTS_PROBE = (fn, ndev)
-    fn, _ = _HOSTS_PROBE
-    return int(np.asarray(fn(jnp.ones((ndev,))))[0]) == ndev
+        _HOSTS_PROBE = (fn, ndev, mesh)
+    fn, _, mesh = _HOSTS_PROBE
+    # each process contributes its local shards (a host-local array
+    # cannot be implicitly resharded onto a multi-process mesh)
+    garr = jax.make_array_from_single_device_arrays(
+        (ndev,), NamedSharding(mesh, P("all")),
+        [jax.device_put(jnp.ones((1,)), d) for d in mesh.local_devices])
+    return int(np.asarray(fn(garr))[0]) == ndev
 
 
 class HeartbeatMonitor:
@@ -88,6 +98,7 @@ class HeartbeatMonitor:
         self.beats = 0
         self.last_error: str = ""
         self._failed = False
+        self._latch_lock = threading.Lock()  # owner + watchdog race
         self._last_beat = time.monotonic()
         self._last_probe = 0.0
         self._stop = threading.Event()
@@ -146,9 +157,13 @@ class HeartbeatMonitor:
             self._thread.join(timeout=self.interval + 1.0)
 
     def _latch(self, err: Optional[Exception]) -> None:
-        if self._failed:
-            return
-        self._failed = True
+        # one-shot across BOTH callers (owner thread at max_misses and
+        # the watchdog on staleness): check-and-set under a lock so a
+        # chained alerting hook can never double-fire
+        with self._latch_lock:
+            if self._failed:
+                return
+            self._failed = True
         if self.on_failure is not None:
             try:
                 self.on_failure(err)

@@ -59,36 +59,53 @@ class ServerState:
                 heartbeat.on_failure = chained
             if not heartbeat._thread.is_alive():
                 heartbeat.start()
+            if not heartbeat.healthy:  # latched before we were handed it
+                self._on_heartbeat_failure(None)
 
     def _on_heartbeat_failure(self, exc) -> None:
         # Runs on the watchdog thread: host-only bookkeeping, no JAX.
         # In the hung-tick scenario the scheduler thread HOLDS self.lock
-        # (stuck inside a device call) — waiting on it would deadlock
-        # the very recovery this exists for. Try briefly, then drain
-        # without it: a thread hung in XLA isn't mutating scheduler
-        # host state, and abort_all is idempotent host bookkeeping.
+        # (stuck inside a device call) — waiting would deadlock the
+        # recovery. Try briefly; on timeout set the error ONLY: the
+        # watchdog cannot distinguish hung from slow, and draining
+        # concurrently with a slow-but-alive tick would corrupt
+        # scheduler state. The scheduler loop drains itself at its next
+        # iteration (error check in _loop); a truly hung tick never
+        # reaches it, but then its host state is frozen and 503s flow.
         self.error = f"heartbeat failed: {self.heartbeat.last_error}"
-        got = self.lock.acquire(timeout=2.0)
-        try:
-            self.sched.abort_all()
-        finally:
-            if got:
+        if self.lock.acquire(timeout=2.0):
+            try:
+                self.sched.abort_all()
+            finally:
                 self.lock.release()
 
     # -- scheduler thread ----------------------------------------------------
 
     def _loop(self) -> None:
         while not self.stop.is_set():
+            if self.error:
+                # wedged (in-tick exception, or the watchdog latched
+                # while we were mid-tick): drain remaining work under
+                # the lock — the single host-only drain path — and
+                # idle. Beat the heartbeat: this loop is alive and
+                # wedged-by-design; re-latching on staleness would
+                # clobber the real root cause in self.error.
+                with self.lock:
+                    if self.sched.has_work:
+                        self.sched.abort_all()
+                if self.heartbeat is not None:
+                    self.heartbeat.beat()
+                self.wake.wait(timeout=0.2)
+                self.wake.clear()
+                continue
             try:
                 with self.lock:
                     has_work = self.sched.has_work
                     made = self.sched.tick() if has_work else 0
-            except Exception as e:  # device/OOM errors must not wedge
+            except Exception as e:  # device/OOM errors must not wedge:
+                # set the error; the wedged branch above drains on the
+                # next iteration (one drain path, not two)
                 self.error = f"{type(e).__name__}: {e}"
-                with self.lock:
-                    # host-only drain — cancel() would touch the (possibly
-                    # dead) device via engine.reset_slot
-                    self.sched.abort_all()
                 continue
             if has_work:
                 if made:
@@ -227,15 +244,21 @@ def make_handler(state: ServerState):
                     try:
                         tok = q.get(timeout=0.5)
                     except queue.Empty:
+                        if req.done or state.error:
+                            break  # wedged/hung: answer with partials
                         if not self._client_alive():
-                            with state.lock:
-                                state.sched.cancel(req)
+                            if state.lock.acquire(timeout=2.0):
+                                try:
+                                    state.sched.cancel(req)
+                                finally:
+                                    state.lock.release()
                             return
                         continue
                     if tok is None:
                         break
                     toks.append(tok)
-                if req.state == "cancelled":
+                if req.state == "cancelled" or (state.error
+                                                and not req.done):
                     self._json(503, {"error": "generation aborted: "
                                      + (state.error or "cancelled"),
                                      "partial_tokens": toks})
@@ -272,13 +295,23 @@ def make_handler(state: ServerState):
 
             try:
                 while True:
-                    tok = q.get()
+                    try:
+                        # bounded wait: a hung device must not pin this
+                        # handler thread forever — bail once the request
+                        # is drained OR the server wedged (a truly hung
+                        # tick never delivers the sentinel)
+                        tok = q.get(timeout=0.5)
+                    except queue.Empty:
+                        if req.done or state.error:
+                            break
+                        continue
                     if tok is None:
                         break
                     piece = state.tok.decode([tok])
                     msg = json.dumps({"token": tok, "text": piece})
                     chunk(f"data: {msg}\n\n".encode())
-                if req.state == "cancelled":
+                if req.state == "cancelled" or (state.error
+                                                and not req.done):
                     err = json.dumps({"error": "generation aborted: "
                                       + (state.error or "cancelled")})
                     chunk(f"data: {err}\n\n".encode())
@@ -286,9 +319,15 @@ def make_handler(state: ServerState):
                     chunk(b"data: [DONE]\n\n")
                 chunk(b"")  # terminating chunk
             except (BrokenPipeError, ConnectionResetError):
-                # client went away: stop generating for a dead socket
-                with state.lock:
-                    state.sched.cancel(req)
+                # client went away: stop generating for a dead socket.
+                # Best-effort cancel: a hung tick may hold the lock
+                # forever — leaking the request is better than pinning
+                # this handler thread on acquire.
+                if state.lock.acquire(timeout=2.0):
+                    try:
+                        state.sched.cancel(req)
+                    finally:
+                        state.lock.release()
 
     return Handler
 
@@ -300,16 +339,16 @@ def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
     """Blocking serve loop. `ready_event` is set once listening (tests).
 
     `heartbeat`: a HeartbeatMonitor to use (callers may tune interval /
-    misses / probe); default builds one with the local device probe, or
-    the all-hosts psum probe when the job spans multiple processes so a
-    dead peer is detected even while idle.
+    misses / probe); defaults to the LOCAL device probe. Deliberately so
+    even multi-host: an idle-timer collective probe would be issued in
+    unsynchronized order across hosts and desync the SPMD program
+    stream — on a pod each host watchdogs its own chip, and a dead PEER
+    surfaces as the next real tick stalling on its collective, which
+    the staleness latch catches.
     """
-    import jax
-    from butterfly_tpu.obs.health import (
-        HeartbeatMonitor, all_hosts_probe)
+    from butterfly_tpu.obs.health import HeartbeatMonitor
     if heartbeat is None:
-        probe = all_hosts_probe if jax.process_count() > 1 else None
-        heartbeat = HeartbeatMonitor(probe=probe)
+        heartbeat = HeartbeatMonitor()
     state = ServerState(scheduler, tokenizer, max_queue,
                         heartbeat=heartbeat)
     state.thread.start()

@@ -42,7 +42,12 @@ def main() -> None:
     total = float(np.asarray(out)[0])
     expect = sum(range(1, jax.device_count() + 1))
     assert total == expect, (total, expect)
-    print(f"proc{pid} psum_ok {total}", flush=True)
+
+    # all_hosts_probe is a collective — both processes reach this same
+    # coordinated point, which is exactly its documented usage contract
+    from butterfly_tpu.obs.health import all_hosts_probe
+    assert all_hosts_probe()
+    print(f"proc{pid} psum_ok {total} hosts_probe_ok", flush=True)
 
 
 if __name__ == "__main__":

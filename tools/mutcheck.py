@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Mutation-testing smoke: prove the suite KILLS planted bugs.
+
+The reference intended mutation testing (cargo-mutants artifacts in its
+.gitignore — SURVEY.md §4); this is the framework's analogue, sized for
+CI: a curated set of single-line mutations in numerically-load-bearing
+code, each of which MUST make its covering test subset fail. A mutant
+that survives means the tests have a blind spot — the tool exits 1 and
+names it.
+
+Usage:  python tools/mutcheck.py            # run all mutants
+        python tools/mutcheck.py --list     # show the catalogue
+
+Each mutation is applied in-place, the covering tests are run in a
+subprocess, and the file is restored from git (requires a clean tree
+for the mutated files).
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: (file, original, mutated, covering-tests, extra-env) — original must
+#: occur exactly once in the file so the mutation is unambiguous.
+MUTANTS = [
+    # rms_norm: drop the rsqrt normalization direction
+    ("butterfly_tpu/models/common.py",
+     "x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)",
+     "x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1.0)",
+     ["tests/test_models.py"], {}),
+    # causal mask off-by-one: attend to the future
+    ("butterfly_tpu/models/common.py",
+     "return j <= positions[:, :, None]",
+     "return j <= positions[:, :, None] + 1",
+     ["tests/test_models.py"], {}),
+    # decode fast path: self-term dropped from the merged softmax.
+    # Killed by the prefill-whole vs incremental-decode invariant
+    # (test_models) — NOT by test_engine, whose compared paths share
+    # decode_attend (first mutcheck run found that blind spot).
+    ("butterfly_tpu/models/common.py",
+     "out = out + p[..., S:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)",
+     "out = out + 0 * p[..., S:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)",
+     ["tests/test_models.py"], {}),
+    # allocator: hand out one page fewer than needed. Must pin the
+    # PYTHON backend: with the native lib built, the scheduler uses the
+    # C++ twin and a Python-side mutation is invisible (first mutcheck
+    # run found that blind spot too).
+    ("butterfly_tpu/cache/allocator.py",
+     "want = -(-new_length // self.page_size)",
+     "want = new_length // self.page_size",
+     ["tests/test_sched.py"], {"BUTTERFLY_NATIVE": "0"}),
+    # scheduler: chunked prefill skips the final prompt token
+    ("butterfly_tpu/sched/scheduler.py",
+     "chunk = prefix[req.prefilled:end]",
+     "chunk = prefix[req.prefilled:max(req.prefilled + 1, end - 1)]",
+     ["tests/test_sched.py"], {}),
+    # paged write: scatter every token to page offset 0
+    ("butterfly_tpu/cache/paged.py",
+     "offset = pos % page",
+     "offset = pos * 0",
+     ["tests/test_paged.py"], {}),
+]
+
+
+def run_tests(tests, extra_env) -> bool:
+    """True if the covering tests PASS (i.e. the mutant survived)."""
+    import os
+    env = dict(os.environ, **extra_env)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q", *tests],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=1200, env=env)
+    return r.returncode == 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args()
+    if args.list:
+        for f, orig, mut, tests, env in MUTANTS:
+            print(f"{f}: {orig!r} -> {mut!r}  [{' '.join(tests)}] {env}")
+        return 0
+
+    dirty = subprocess.run(
+        ["git", "diff", "--name-only"], cwd=REPO,
+        capture_output=True, text=True).stdout.split()
+    mutated_files = {m[0] for m in MUTANTS}
+    if mutated_files & set(dirty):
+        print(f"refusing to run: uncommitted changes in {mutated_files & set(dirty)}")
+        return 2
+
+    survived = []
+    for i, (fname, orig, mut, tests, extra_env) in enumerate(MUTANTS):
+        path = REPO / fname
+        src = path.read_text()
+        assert src.count(orig) == 1, f"ambiguous mutation site in {fname}"
+        print(f"[{i + 1}/{len(MUTANTS)}] {fname}: {orig[:50]!r}...",
+              flush=True)
+        path.write_text(src.replace(orig, mut))
+        try:
+            if run_tests(tests, extra_env):
+                survived.append((fname, orig))
+                print("  SURVIVED — tests have a blind spot", flush=True)
+            else:
+                print("  killed", flush=True)
+        finally:
+            subprocess.run(["git", "checkout", "--", fname], cwd=REPO,
+                           check=True)
+
+    if survived:
+        print(f"\n{len(survived)} mutant(s) survived:")
+        for fname, orig in survived:
+            print(f"  {fname}: {orig!r}")
+        return 1
+    print(f"\nall {len(MUTANTS)} mutants killed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
