@@ -12,7 +12,8 @@ from common import emit, parse_args, timed  # noqa: E402
 
 
 def main():
-    args = parse_args("Llama-3 70B TPxPP", tp=4, pp=2, microbatches=4)
+    args = parse_args("Llama-3 70B TPxPP", tp=4, pp=2, microbatches=4,
+                      virtual_stages=1)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -24,12 +25,26 @@ def main():
     from butterfly_tpu.parallel.pipeline import pipeline_forward
 
     n = args.tp * args.pp
-    cfg = tiny("llama", num_layers=2 * args.pp, dtype="float32",
+    V = args.virtual_stages
+    # tiny depth fixed at 4*pp (divisible by pp*V for V in {1,2,4}) so
+    # an A/B over --virtual-stages compares the SCHEDULE, not model depth
+    cfg = tiny("llama", num_layers=4 * args.pp, dtype="float32",
                param_dtype="float32") if args.tiny else llama3_70b()
     mesh = make_mesh(MeshConfig(stage=args.pp, tensor=args.tp),
                      jax.devices()[:n])
     model = Model(cfg)
-    params = shard_params(model.init(jax.random.PRNGKey(0)), cfg, mesh)
+    params = model.init(jax.random.PRNGKey(0))
+    if V > 1:
+        # interleaved 1F1B-style schedule: one-time layer permutation,
+        # donated so the full stack is never transiently duplicated
+        from functools import partial
+        from butterfly_tpu.parallel.pipeline import interleave_layers
+        perm = jax.jit(partial(interleave_layers,
+                               num_layers=cfg.num_layers, S=args.pp, V=V),
+                       donate_argnums=(0,))
+        params = dict(params)
+        params["layers"] = perm(params["layers"])
+    params = shard_params(params, cfg, mesh)
     cache = shard_cache(
         init_cache(cfg, args.batch, args.prompt_len + args.max_new),
         cfg, mesh)
@@ -40,7 +55,8 @@ def main():
 
     def step(params, tokens, cache):
         return pipeline_forward(params, cfg, tokens, cache, mesh,
-                                num_microbatches=args.microbatches)
+                                num_microbatches=args.microbatches,
+                                virtual_stages=V)
 
     with jax.set_mesh(mesh):
         (_, cache), dt_prefill = timed(jax.jit(step), params, tokens, cache)
@@ -51,6 +67,7 @@ def main():
     toks = args.batch / dt_decode
     emit("llama70b_tp_pp_decode_tokens_per_sec", toks, "tokens/sec",
          config="baseline_config_2", tp=args.tp, pp=args.pp,
+         virtual_stages=V,
          tokens_per_sec_per_chip=round(toks / n, 2),
          ttft_s=round(dt_prefill, 4))
 
