@@ -100,7 +100,10 @@ class HeartbeatMonitor:
         self._failed = False
         self._latch_lock = threading.Lock()  # owner + watchdog race
         self._last_beat = time.monotonic()
-        self._last_probe = 0.0
+        # -inf, not 0.0: monotonic() is time-since-boot, so on a freshly
+        # booted host 0.0 can be within `interval` of now and the first
+        # maybe_probe() would silently skip.
+        self._last_probe = float("-inf")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._watchdog, daemon=True)
 
