@@ -48,6 +48,11 @@ class PageAllocator:
 
     # -- mutations ----------------------------------------------------------
 
+    def _take_free(self) -> int:
+        """Pop one free page. Subclass hook: PrefixCachingAllocator
+        evicts a warm cached page here when the raw free list is dry."""
+        return self._free.pop()
+
     def grow(self, slot: int, new_length: int) -> Optional[List[int]]:
         """Allocate pages so `slot` can hold new_length tokens.
 
@@ -58,7 +63,7 @@ class PageAllocator:
         if not self.can_grow(slot, new_length):
             return None
         n = self.pages_needed(slot, new_length)
-        fresh = [self._free.pop() for _ in range(n)]
+        fresh = [self._take_free() for _ in range(n)]
         self._owned.setdefault(slot, []).extend(fresh)
         return fresh
 
@@ -67,6 +72,18 @@ class PageAllocator:
         pages = self._owned.pop(slot, [])
         self._free.extend(reversed(pages))
         return pages
+
+    # -- prefix-caching interface (no-op here; cache/prefix.py overrides) ----
+
+    def admit(self, slot: int, tokens, need_len: int) -> Optional[int]:
+        """Allocate a fresh slot through need_len tokens; returns the
+        number of prompt tokens already cached (always 0 here) or None
+        if it cannot fit. PrefixCachingAllocator shares matched pages."""
+        return None if self.grow(slot, need_len) is None else 0
+
+    def register(self, slot: int, tokens) -> int:
+        """Publish a slot's pages for reuse (no registry here)."""
+        return 0
 
 
 def make_page_allocator(num_pages: int, page_size: int,

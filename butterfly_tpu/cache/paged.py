@@ -93,6 +93,11 @@ def write_paged_layer(k_pages: jax.Array, v_pages: jax.Array,
     B, T = k.shape[0], k.shape[1]
     pos = start[:, None] + jnp.arange(T)[None, :]          # [B,T] absolute
     page_idx = jnp.take_along_axis(page_table, pos // page, axis=1)  # [B,T]
+    # Prefill buckets pad T past the true prompt, so pos can exceed the
+    # table row's capacity. Route those positions to the null page
+    # explicitly rather than relying on take_along_axis's out-of-bounds
+    # fill (INT32_MIN) being dropped by the scatter below.
+    page_idx = jnp.where(pos < page_table.shape[1] * page, page_idx, Pp - 1)
     if active is not None:
         page_idx = jnp.where(active[:, None], page_idx, Pp - 1)
     offset = pos % page                                     # [B,T]

@@ -188,6 +188,9 @@ class RuntimeConfig:
                                       # interleave) | "static" (drain batches)
     max_queue: int = 256
     decode_steps_per_tick: int = 1    # decode steps run per tick()
+    prefix_caching: bool = False      # content-hash KV page reuse across
+                                      # requests (cache/prefix.py): shared
+                                      # prompt prefixes skip prefill entirely
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

@@ -131,3 +131,12 @@ class NativePageAllocator:
         pages = self.pages_of(slot)
         self._lib.bfa_release(self._h, slot)
         return pages
+
+    # -- prefix-caching interface (same no-op contract as the Python
+    # PageAllocator; the refcounted variant lives in cache/prefix.py) ----
+
+    def admit(self, slot: int, tokens, need_len: int) -> Optional[int]:
+        return None if self.grow(slot, need_len) is None else 0
+
+    def register(self, slot: int, tokens) -> int:
+        return 0

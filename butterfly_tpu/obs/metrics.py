@@ -25,10 +25,13 @@ HELP = {
     "ttft_p95": "p95 time-to-first-token (seconds)",
     "tokens_per_sec": "Decode throughput over the last window",
     "uptime_seconds": "Server uptime",
+    "prefix_cache_hit_tokens": "Prompt tokens served from the prefix cache",
+    "prefix_cache_lookup_tokens": "Prompt tokens looked up in the prefix cache",
 }
 
 COUNTERS = {"requests_total", "requests_finished", "tokens_generated_total",
-            "preemptions_total"}
+            "preemptions_total", "prefix_cache_hit_tokens",
+            "prefix_cache_lookup_tokens"}
 
 
 class ThroughputWindow:

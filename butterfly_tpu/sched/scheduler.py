@@ -85,9 +85,14 @@ class Scheduler:
             raise ValueError(f"unknown scheduler {rt.scheduler!r}: "
                              "expected 'continuous' or 'static'")
         max_pages = engine.cache.page_table.shape[1]
-        self.alloc = make_page_allocator(engine.cache.num_pages - 1,
-                                         engine.cache.page_size, max_pages,
-                                         num_slots=engine.num_slots)
+        if rt.prefix_caching:
+            from butterfly_tpu.cache.prefix import PrefixCachingAllocator
+            self.alloc = PrefixCachingAllocator(
+                engine.cache.num_pages - 1, engine.cache.page_size, max_pages)
+        else:
+            self.alloc = make_page_allocator(engine.cache.num_pages - 1,
+                                             engine.cache.page_size, max_pages,
+                                             num_slots=engine.num_slots)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
         self._prefilling: Optional[Request] = None  # mid-chunked-prefill
@@ -196,6 +201,9 @@ class Scheduler:
         m["active_requests"] = len(self._all_live)
         m["kv_pages_free"] = self.alloc.free_pages
         m["kv_pages_total"] = self.alloc.num_pages
+        if hasattr(self.alloc, "hit_tokens"):
+            m["prefix_cache_hit_tokens"] = self.alloc.hit_tokens
+            m["prefix_cache_lookup_tokens"] = self.alloc.lookup_tokens
         if self._ttfts:
             a = np.asarray(self._ttfts)
             m["ttft_p50"] = float(np.percentile(a, 50))
@@ -229,15 +237,22 @@ class Scheduler:
                 if slot is None:
                     return
                 req = self.waiting[0]
-                # includes output if preempted earlier
-                if self.alloc.grow(slot, len(req.all_tokens) + 1) is None:
+                # all_tokens includes output if preempted earlier; admit
+                # may attach already-cached prefix pages (prefix caching),
+                # whose tokens skip prefill entirely via the warm path.
+                cached = self.alloc.admit(slot, req.all_tokens,
+                                          len(req.all_tokens) + 1)
+                if cached is None:
                     return  # pool exhausted; decode will free/preempt
                 self.waiting.popleft()
                 req.slot, req.state = slot, "prefilling"
-                req.prefilled = 0
+                req.prefilled = cached
                 self.slots[slot] = req
                 self._prefilling = req
                 self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+                # (no length bookkeeping for `cached` needed: the first
+                # warm chunk below runs in this same call and sets
+                # lengths[slot] = cached + len(chunk))
 
             req = self._prefilling
             prefix = req.all_tokens
@@ -251,7 +266,10 @@ class Scheduler:
             if req.prefilled < len(prefix):
                 return  # chunk budget spent; continue next tick
 
-            # prompt fully in cache: sample the first token, start decoding
+            # prompt fully in cache: publish its full pages for prefix
+            # reuse (no-op without prefix caching), sample the first
+            # token, start decoding
+            self.alloc.register(req.slot, prefix)
             self._prefilling = None
             req.state = "running"
             self.running.append(req)
@@ -300,6 +318,11 @@ class Scheduler:
             self._finish(req)
 
     def _finish(self, req: Request, state: str = "finished") -> None:
+        if req.slot is not None:
+            # publish the written tokens' full pages before releasing
+            # (the latest sampled token's K/V is never written — it
+            # would have landed on the NEXT decode step)
+            self.alloc.register(req.slot, req.all_tokens[:self._written(req)])
         req.state = state
         req.t_finish = time.monotonic()
         if self._prefilling is req:  # cancelled mid-chunked-prefill
@@ -331,10 +354,21 @@ class Scheduler:
             if victim is req:
                 return
 
+    def _written(self, req: Request) -> int:
+        """Tokens whose K/V the device has actually written for req's
+        slot: everything prefilled, plus decoded tokens except the last
+        sampled one (written on the next step, which never ran)."""
+        if req.state == "prefilling":
+            return req.prefilled
+        return len(req.all_tokens) - 1
+
     def _preempt(self, req: Request) -> None:
-        """Recompute-style preemption: free pages, requeue at the front."""
+        """Recompute-style preemption: free pages, requeue at the front.
+        With prefix caching the pages stay warm in the registry, so
+        readmission's "recompute" is usually a cache hit."""
         self._metrics["preemptions_total"] += 1
         req.preemptions += 1
+        self.alloc.register(req.slot, req.all_tokens[:self._written(req)])
         self.alloc.release(req.slot)
         self.engine.reset_slot(req.slot)
         self.slots[req.slot] = None

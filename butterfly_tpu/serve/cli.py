@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving-wide top-k sampling filter")
     s.add_argument("--top-p", type=float, default=1.0)
     s.add_argument("--max-queue", type=int, default=256)
+    s.add_argument("--prefix-caching", action="store_true",
+                   help="reuse KV pages across requests sharing a prompt "
+                        "prefix (content-hashed, refcounted; cuts TTFT for "
+                        "shared system prompts)")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)

@@ -4,11 +4,17 @@
 stdlib-only (ThreadingHTTPServer — no web framework dependencies, per the
 zero-egress environment):
 
-* POST /generate  {"prompt": str | "tokens": [int], "max_tokens",
-                   "temperature", "stop_token", "stream": bool}
+* POST /generate  {"prompt": str | "tokens": [int], "max_tokens"
+                   (alias "max_new_tokens"), "temperature", "stop_token",
+                   "stream": bool}
   -> {"text", "tokens", "ttft_s", "total_s"}; with "stream": true the
   response is SSE (`data: {"token": id, "text": piece}` per token,
   terminated by `data: [DONE]`).
+* POST /v1/completions  OpenAI-completions-compatible (single choice):
+  {"prompt": str | [int], "max_tokens", "temperature", "stop" (string or
+  up to 4 strings, matched on decoded text with streaming holdback),
+  "stream"} -> {"id", "object": "text_completion", "choices": [{"text",
+  "finish_reason"}], "usage"}; streaming sends OpenAI-style SSE chunks.
 * GET /metrics    Prometheus text (obs/metrics.py)
 * GET /health     {"status": "ok"}
 
@@ -28,11 +34,69 @@ from typing import Optional
 from butterfly_tpu.obs.metrics import ThroughputWindow, render_prometheus
 
 
+class StopSequenceMatcher:
+    """Incremental stop-sequence detection over streamed text.
+
+    OpenAI's `stop` parameter is a string (or up to 4 strings) that ends
+    generation, with the matched text EXCLUDED from the output. Matching
+    is on decoded text, not token ids, so a stop sequence split across
+    token boundaries still hits. `feed` returns the text that is safe to
+    release now: everything except the longest trailing run that could
+    still grow into a stop sequence (the holdback keeps streaming from
+    ever emitting a byte of the stop text).
+    """
+
+    def __init__(self, stops):
+        self.stops = [s for s in stops if s]
+        self._maxlen = max((len(s) for s in self.stops), default=0)
+        self.text = ""       # everything fed so far
+        self.released = 0    # chars already returned to the caller
+        self.hit = False
+
+    def feed(self, piece: str) -> str:
+        if self.hit:
+            return ""
+        prev_len = len(self.text)
+        self.text += piece
+        # A match cannot start in already-released text (it would have
+        # hit or been held back when that text arrived), so only scan
+        # from maxlen-1 chars before the new piece — O(piece), not
+        # O(total generation), per token.
+        scan_from = max(self.released, prev_len - self._maxlen + 1, 0)
+        cut = min((i for i in (self.text.find(s, scan_from)
+                               for s in self.stops) if i >= 0), default=-1)
+        if cut >= 0:
+            self.hit = True
+            out = self.text[self.released:cut]
+            self.released = cut
+            return out
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.text)), 0, -1):
+                if self.text.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        safe_to = len(self.text) - hold
+        out = self.text[self.released:safe_to] \
+            if safe_to > self.released else ""
+        self.released = max(self.released, safe_to)
+        return out
+
+    def flush(self) -> str:
+        """Release the holdback (generation ended without a hit)."""
+        if self.hit:
+            return ""
+        out = self.text[self.released:]
+        self.released = len(self.text)
+        return out
+
+
 class ServerState:
     def __init__(self, scheduler, tokenizer, max_queue: int = 256,
-                 heartbeat=None):
+                 heartbeat=None, model_name: str = "butterfly"):
         self.sched = scheduler
         self.tok = tokenizer
+        self.model_name = model_name  # echoed by /v1/completions
         self.lock = threading.Lock()       # guards scheduler state
         self.wake = threading.Event()      # new work signal
         self.stop = threading.Event()
@@ -188,87 +252,220 @@ def make_handler(state: ServerState):
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path != "/generate":
+            if self.path == "/generate":
+                self._handle_generate()
+            elif self.path == "/v1/completions":
+                self._handle_completions()
+            else:
                 self._json(404, {"error": "not found"})
-                return
-            try:
-                n = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(n) or b"{}")
-                if not isinstance(body, dict):
-                    raise ValueError("body must be a JSON object")
-                if "tokens" in body:
-                    tokens = [int(t) for t in body["tokens"]]
-                else:
-                    tokens = state.tok.encode(str(body.get("prompt", "")))
-                vocab = state.sched.engine.cfg.vocab_size
-                if any(t >= vocab or t < 0 for t in tokens):
-                    raise ValueError("token id out of range")
-                if not tokens:
-                    raise ValueError("empty prompt")
-                max_seq = state.sched.engine.cache.max_seq
-                max_tokens = int(body.get("max_tokens", 64))
-                if max_tokens < 1:
-                    raise ValueError("max_tokens must be >= 1")
-                if len(tokens) + max_tokens > max_seq:
-                    raise ValueError(
-                        f"prompt+max_tokens exceeds max_seq {max_seq}")
-                temperature = float(body.get("temperature", 0.0))
-                stop = int(body.get("stop_token",
-                                    -1 if state.tok.eos_id is None
-                                    else state.tok.eos_id))
-            except (ValueError, TypeError, KeyError) as e:
-                self._json(400, {"error": str(e)})
-                return
-            if state.error:
-                self._json(503, {"error": "server wedged: " + state.error})
-                return
-            t0 = time.monotonic()
 
+        def _read_body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+
+        def _parse_request(self, body: dict):
+            """Shared validation -> (tokens, max_tokens, temperature, stop).
+
+            Accepts our native schema and the OpenAI-completions field
+            names (`prompt` may be a string OR a token-id list there;
+            `max_new_tokens` is accepted as a `max_tokens` alias)."""
+            if "tokens" in body:
+                tokens = [int(t) for t in body["tokens"]]
+            else:
+                prompt = body.get("prompt", "")
+                if isinstance(prompt, list):  # OpenAI token-id form
+                    tokens = [int(t) for t in prompt]
+                else:
+                    tokens = state.tok.encode(str(prompt))
+            vocab = state.sched.engine.cfg.vocab_size
+            if any(t >= vocab or t < 0 for t in tokens):
+                raise ValueError("token id out of range")
+            if not tokens:
+                raise ValueError("empty prompt")
+            max_seq = state.sched.engine.cache.max_seq
+            max_tokens = int(body.get("max_tokens",
+                                      body.get("max_new_tokens", 64)))
+            if max_tokens < 1:
+                raise ValueError("max_tokens must be >= 1")
+            if len(tokens) + max_tokens > max_seq:
+                raise ValueError(
+                    f"prompt+max_tokens exceeds max_seq {max_seq}")
+            temperature = float(body.get("temperature", 0.0))
+            stop = int(body.get("stop_token",
+                                -1 if state.tok.eos_id is None
+                                else state.tok.eos_id))
+            return tokens, max_tokens, temperature, stop
+
+        def _admit(self, body: dict, openai: bool = False):
+            """Parse + submit; handles every error response (in the
+            OpenAI error-envelope shape when `openai`). Returns
+            (req, queue) or None if a response was already sent."""
+            def err(code: int, msg: str, etype: str) -> None:
+                if openai:
+                    self._json(code, {"error": {"message": msg,
+                                                "type": etype}})
+                else:
+                    self._json(code, {"error": msg})
+
+            try:
+                tokens, max_tokens, temperature, stop = \
+                    self._parse_request(body)
+            except (ValueError, TypeError, KeyError) as e:
+                err(400, str(e), "invalid_request_error")
+                return None
+            if state.error:
+                err(503, "server wedged: " + state.error, "server_error")
+                return None
             try:
                 req, q = state.submit(tokens, max_tokens, temperature, stop)
             except ValueError as e:  # can never fit the page pool
+                err(400, str(e), "invalid_request_error")
+                return None
+            except RuntimeError as e:  # wedged while we were admitting
+                err(503, str(e), "server_error")
+                return None
+            if req is None:
+                err(429, "queue full", "rate_limit_error")
+                return None
+            return req, q
+
+        def _cancel_request(self, req) -> None:
+            """Best-effort cancel from a handler thread: a hung tick may
+            hold the lock forever — leaking the request is better than
+            pinning this thread on acquire."""
+            if state.lock.acquire(timeout=2.0):
+                try:
+                    state.sched.cancel(req)
+                finally:
+                    state.lock.release()
+
+        def _collect(self, req, q, matcher=None):
+            """Drain q until the finish sentinel. Returns (tokens,
+            aborted) — or None if the client vanished (cancelled, no
+            response owed). `matcher` (StopSequenceMatcher) ends
+            generation early when a stop sequence appears in the text."""
+            toks = []
+            while True:
+                try:
+                    tok = q.get(timeout=0.5)
+                except queue.Empty:
+                    if req.done or state.error:
+                        break  # wedged/hung: answer with partials
+                    if not self._client_alive():
+                        self._cancel_request(req)
+                        return None
+                    continue
+                if tok is None:
+                    break
+                toks.append(tok)
+                if matcher is not None and not matcher.hit \
+                        and not (req.stop_token >= 0
+                                 and tok == req.stop_token):
+                    matcher.feed(state.tok.decode([tok]))
+                    if matcher.hit:
+                        self._cancel_request(req)
+            stop_hit = matcher is not None and matcher.hit
+            aborted = (req.state == "cancelled" and not stop_hit) \
+                or (state.error and not req.done)
+            return toks, aborted
+
+        def _handle_generate(self):
+            try:
+                body = self._read_body()
+            except (ValueError, TypeError) as e:
                 self._json(400, {"error": str(e)})
                 return
-            except RuntimeError as e:  # wedged while we were admitting
-                self._json(503, {"error": str(e)})
+            t0 = time.monotonic()
+            admitted = self._admit(body)
+            if admitted is None:
                 return
-            if req is None:
-                self._json(429, {"error": "queue full"})
-                return
-
+            req, q = admitted
             if body.get("stream"):
                 self._stream(req, q, t0)
+                return
+            got = self._collect(req, q)
+            if got is None:
+                return
+            toks, aborted = got
+            if aborted:
+                self._json(503, {"error": "generation aborted: "
+                                 + (state.error or "cancelled"),
+                                 "partial_tokens": toks})
+                return
+            self._json(200, {
+                "tokens": toks,
+                "text": state.tok.decode(toks),
+                "ttft_s": req.ttft,
+                "total_s": time.monotonic() - t0,
+            })
+
+        def _handle_completions(self):
+            """OpenAI-compatible /v1/completions (single choice)."""
+            try:
+                body = self._read_body()
+                n_choices = int(body.get("n", 1))
+                stops = body.get("stop") or []
+                if isinstance(stops, str):
+                    stops = [stops]
+                if not (isinstance(stops, list)
+                        and all(isinstance(s, str) for s in stops)):
+                    raise ValueError("stop must be a string or a list "
+                                     "of strings")
+                if len(stops) > 4:
+                    raise ValueError("at most 4 stop sequences")
+            except (ValueError, TypeError) as e:
+                self._json(400, {"error": {"message": str(e),
+                                           "type": "invalid_request_error"}})
+                return
+            if n_choices != 1:
+                self._json(400, {"error": {"message": "only n=1 supported",
+                                           "type": "invalid_request_error"}})
+                return
+            admitted = self._admit(body, openai=True)
+            if admitted is None:
+                return
+            req, q = admitted
+            matcher = StopSequenceMatcher(stops) if stops else None
+            meta = {"id": f"cmpl-{req.id}", "object": "text_completion",
+                    "created": int(time.time()), "model": state.model_name}
+            if body.get("stream"):
+                self._stream_completions(req, q, meta, matcher)
+                return
+            got = self._collect(req, q, matcher)
+            if got is None:
+                return
+            toks, aborted = got
+            if aborted:
+                self._json(503, {"error": {
+                    "message": "generation aborted: "
+                               + (state.error or "cancelled"),
+                    "type": "server_error"}})
+                return
+            token_stop = (req.stop_token >= 0 and toks
+                          and toks[-1] == req.stop_token)
+            if matcher is not None:
+                # text comes from the matcher: everything before the
+                # stop sequence (or everything fed, if none hit)
+                matcher.flush()
+                text = matcher.text[:matcher.released]
+                finish = "stop" if (matcher.hit or token_stop) else "length"
             else:
-                toks = []
-                while True:
-                    try:
-                        tok = q.get(timeout=0.5)
-                    except queue.Empty:
-                        if req.done or state.error:
-                            break  # wedged/hung: answer with partials
-                        if not self._client_alive():
-                            if state.lock.acquire(timeout=2.0):
-                                try:
-                                    state.sched.cancel(req)
-                                finally:
-                                    state.lock.release()
-                            return
-                        continue
-                    if tok is None:
-                        break
-                    toks.append(tok)
-                if req.state == "cancelled" or (state.error
-                                                and not req.done):
-                    self._json(503, {"error": "generation aborted: "
-                                     + (state.error or "cancelled"),
-                                     "partial_tokens": toks})
-                    return
-                self._json(200, {
-                    "tokens": toks,
-                    "text": state.tok.decode(toks),
-                    "ttft_s": req.ttft,
-                    "total_s": time.monotonic() - t0,
-                })
+                # OpenAI semantics: the stop marker is excluded from the
+                # text (usage still counts it — it was generated)
+                finish = "stop" if token_stop else "length"
+                text = state.tok.decode(
+                    toks[:-1] if token_stop else toks)
+            self._json(200, {
+                **meta,
+                "choices": [{"text": text, "index": 0,
+                             "logprobs": None, "finish_reason": finish}],
+                "usage": {"prompt_tokens": len(req.prompt),
+                          "completion_tokens": len(toks),
+                          "total_tokens": len(req.prompt) + len(toks)},
+            })
 
         def _client_alive(self) -> bool:
             """Peek the socket: a closed peer reads as EOF (b'')."""
@@ -282,7 +479,17 @@ def make_handler(state: ServerState):
             except OSError:
                 return False
 
-        def _stream(self, req, q, t0) -> None:
+        def _sse(self, req, q, render_token, finish_payloads,
+                 render_error, natural_cancel=lambda: False) -> None:
+            """Shared SSE drain: headers, chunked framing, bounded-wait
+            queue loop, wedge/cancel detection, disconnect cancel.
+
+            render_token(tok) -> payload str or None (skip the chunk);
+            finish_payloads(last_tok) -> payload strs on normal finish;
+            render_error(msg) -> payload str for the abort event;
+            natural_cancel() -> True when a handler-initiated cancel is
+            a normal finish (stop-sequence hit), not an abort.
+            """
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -294,6 +501,7 @@ def make_handler(state: ServerState):
                                  + b"\r\n")
 
             try:
+                last_tok = None
                 while True:
                     try:
                         # bounded wait: a hung device must not pin this
@@ -307,27 +515,77 @@ def make_handler(state: ServerState):
                         continue
                     if tok is None:
                         break
-                    piece = state.tok.decode([tok])
-                    msg = json.dumps({"token": tok, "text": piece})
-                    chunk(f"data: {msg}\n\n".encode())
-                if req.state == "cancelled" or (state.error
-                                                and not req.done):
-                    err = json.dumps({"error": "generation aborted: "
-                                      + (state.error or "cancelled")})
+                    last_tok = tok
+                    payload = render_token(tok)
+                    if payload is not None:
+                        chunk(f"data: {payload}\n\n".encode())
+                if (req.state == "cancelled" and not natural_cancel()) \
+                        or (state.error and not req.done):
+                    err = render_error("generation aborted: "
+                                       + (state.error or "cancelled"))
                     chunk(f"data: {err}\n\n".encode())
                 else:
-                    chunk(b"data: [DONE]\n\n")
+                    for payload in finish_payloads(last_tok):
+                        chunk(f"data: {payload}\n\n".encode())
                 chunk(b"")  # terminating chunk
             except (BrokenPipeError, ConnectionResetError):
-                # client went away: stop generating for a dead socket.
-                # Best-effort cancel: a hung tick may hold the lock
-                # forever — leaking the request is better than pinning
-                # this handler thread on acquire.
-                if state.lock.acquire(timeout=2.0):
-                    try:
-                        state.sched.cancel(req)
-                    finally:
-                        state.lock.release()
+                # client went away: stop generating for a dead socket
+                self._cancel_request(req)
+
+        def _stream(self, req, q, t0) -> None:
+            self._sse(
+                req, q,
+                lambda tok: json.dumps({"token": tok,
+                                        "text": state.tok.decode([tok])}),
+                lambda last: ["[DONE]"],
+                lambda msg: json.dumps({"error": msg}))
+
+        def _stream_completions(self, req, q, meta, matcher=None) -> None:
+            """SSE in the OpenAI streaming-chunk shape. With a stop-
+            sequence matcher, only text provably before any stop
+            sequence streams out (holdback), and a hit cancels the
+            request as a NORMAL finish."""
+            def content(text):
+                return json.dumps({**meta, "choices": [
+                    {"text": text, "index": 0, "logprobs": None,
+                     "finish_reason": None}]})
+
+            def render_token(tok):
+                if req.stop_token >= 0 and tok == req.stop_token:
+                    return None  # stop marker is excluded from the text
+                piece = state.tok.decode([tok])
+                if matcher is not None:
+                    if matcher.hit:
+                        return None  # tokens racing in after the hit
+                    piece = matcher.feed(piece)
+                    if matcher.hit:
+                        self._cancel_request(req)
+                    if not piece:
+                        return None
+                return content(piece)
+
+            def finish_payloads(last_tok):
+                msgs = []
+                stop_hit = matcher is not None and matcher.hit
+                if matcher is not None and not stop_hit:
+                    tail = matcher.flush()
+                    if tail:
+                        msgs.append(content(tail))
+                finish = "stop" if (stop_hit or (req.stop_token >= 0
+                                                 and last_tok
+                                                 == req.stop_token)) \
+                    else "length"
+                msgs.append(json.dumps({**meta, "choices": [
+                    {"text": "", "index": 0, "logprobs": None,
+                     "finish_reason": finish}]}))
+                msgs.append("[DONE]")
+                return msgs
+
+            self._sse(req, q, render_token, finish_payloads,
+                      lambda msg: json.dumps({"error": {
+                          "message": msg, "type": "server_error"}}),
+                      natural_cancel=lambda: (matcher is not None
+                                              and matcher.hit))
 
     return Handler
 
@@ -335,7 +593,7 @@ def make_handler(state: ServerState):
 def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
                   port: int = 8000, max_queue: int = 256,
                   ready_event: Optional[threading.Event] = None,
-                  heartbeat=None):
+                  heartbeat=None, model_name: str = "butterfly"):
     """Blocking serve loop. `ready_event` is set once listening (tests).
 
     `heartbeat`: a HeartbeatMonitor to use (callers may tune interval /
@@ -350,7 +608,7 @@ def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
     if heartbeat is None:
         heartbeat = HeartbeatMonitor()
     state = ServerState(scheduler, tokenizer, max_queue,
-                        heartbeat=heartbeat)
+                        heartbeat=heartbeat, model_name=model_name)
     state.thread.start()
     httpd = ThreadingHTTPServer((host, port), make_handler(state))
     state.httpd = httpd
@@ -381,7 +639,8 @@ def run_server(args) -> int:
     rt = RuntimeConfig(max_batch_size=args.max_batch,
                        max_seq_len=args.max_seq, page_size=args.page_size,
                        top_k=args.top_k, top_p=args.top_p,
-                       max_queue=args.max_queue)
+                       max_queue=args.max_queue,
+                       prefix_caching=getattr(args, "prefix_caching", False))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     sched = Scheduler(engine)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk
@@ -400,4 +659,4 @@ def run_server(args) -> int:
           f"(slots={rt.max_batch_size}, pages={engine.cache.num_pages - 1}"
           f"x{rt.page_size}tok{mesh_desc})", flush=True)
     return serve_forever(sched, tok, args.host, args.port,
-                         max_queue=rt.max_queue)
+                         max_queue=rt.max_queue, model_name=args.model)

@@ -114,3 +114,37 @@ def test_allocator_property_accounting(lengths):
     for s in range(len(lengths)):
         a.release(s)
     assert a.free_pages == 32
+
+
+def test_near_capacity_prompt_bucket_padding_no_corruption():
+    """A prompt whose prefill bucket pads past the block-table capacity
+    must not corrupt the slot's own pages.
+
+    max_seq=96 (not a power of two), page=8 -> 12-entry rows. A 90-token
+    prompt owns all 12 pages; its bucket pads to 128 positions, so the
+    writer sees positions 96..127 with no table entry. write_paged_layer
+    routes them to the null page explicitly; this pins greedy parity
+    with the contiguous engine so that contract can never regress."""
+    import numpy as np
+    from butterfly_tpu.core.config import RuntimeConfig, tiny
+    from butterfly_tpu.engine import InferenceEngine, SamplingParams
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(7))
+    prompt = [int(t) for t in
+              np.random.RandomState(0).randint(0, cfg.vocab_size, 90)]
+
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=96, page_size=8,
+                       prefill_chunk=512)  # whole-prompt bucket: 128 > 96
+    sched = Scheduler(ServingEngine(model, params, rt, use_kernels=False))
+    req = sched.submit(prompt, max_new_tokens=5)
+    sched.run_until_done()
+
+    ref = InferenceEngine(model, params).generate(
+        [prompt], SamplingParams(max_new_tokens=5))
+    want = ref.tokens[0, :int(ref.lengths[0])].tolist()
+    assert req.output == want

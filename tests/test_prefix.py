@@ -1,0 +1,278 @@
+"""Prefix caching (cache/prefix.py): content-hash KV page reuse.
+
+Parity contract: with prefix_caching on, every request's tokens must be
+IDENTICAL to the uncached scheduler's — sharing pages changes where K/V
+bytes live, never what attention reads. Allocator-level tests drive the
+refcount/eviction machinery directly and check the full-accounting
+invariant after every mutation.
+"""
+import numpy as np
+import pytest
+
+from butterfly_tpu.cache.prefix import PrefixCachingAllocator
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.engine.serving import ServingEngine
+from butterfly_tpu.models.common import Model
+from butterfly_tpu.sched.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# allocator unit tests (pure host)
+# ---------------------------------------------------------------------------
+
+PS = 4  # page size for allocator tests
+
+
+def toks(*vals):
+    return list(vals)
+
+
+def test_admit_miss_then_hit():
+    a = PrefixCachingAllocator(num_pages=16, page_size=PS, max_pages_per_seq=8)
+    seq = list(range(10))  # 2 full pages + 2 tokens
+    assert a.admit(0, seq, len(seq) + 1) == 0
+    a.register(0, seq)
+    a.release(0)
+    a.check_invariants()
+    # identical prompt: both full pages hit; tail tokens still prefill
+    assert a.admit(1, seq, len(seq) + 1) == 2 * PS
+    a.check_invariants()
+    # diverging second page: only the first page hits
+    seq2 = seq[:PS] + [99] * 6
+    assert a.admit(2, seq2, len(seq2) + 1) == PS
+    a.check_invariants()
+
+
+def test_match_capped_below_full_prompt():
+    """A fully-cached prompt must still leave >=1 token to prefill."""
+    a = PrefixCachingAllocator(num_pages=16, page_size=PS, max_pages_per_seq=8)
+    seq = list(range(8))  # exactly 2 pages
+    a.admit(0, seq, len(seq) + 1)
+    a.register(0, seq)
+    a.release(0)
+    # (len-1)//PS = 1: only the first page may hit
+    assert a.admit(1, seq, len(seq) + 1) == PS
+
+
+def test_shared_page_refcount_and_release():
+    a = PrefixCachingAllocator(num_pages=8, page_size=PS, max_pages_per_seq=8)
+    seq = list(range(9))
+    a.admit(0, seq, len(seq) + 1)
+    a.register(0, seq)
+    assert a.admit(1, seq, len(seq) + 1) == 2 * PS
+    a.check_invariants()
+    shared = set(a.pages_of(0)[:2])
+    assert shared == set(a.pages_of(1)[:2])
+    # releasing one holder must NOT free the shared pages
+    free_before = len(a._free)
+    a.release(0)
+    a.check_invariants()
+    assert shared & set(a.pages_of(1)) == shared
+    # slot 0's private page went back to the free list; shared ones didn't
+    assert len(a._free) == free_before + 1
+    a.release(1)
+    a.check_invariants()
+    # now refcount 0: warm (evictable), still not on the raw free list
+    assert all(p in a._evictable for p in shared)
+
+
+def test_eviction_lru_under_pressure():
+    a = PrefixCachingAllocator(num_pages=4, page_size=PS, max_pages_per_seq=4)
+    for i, base in enumerate((0, 100)):
+        seq = [base + t for t in range(PS + 1)]
+        assert a.admit(i, seq, len(seq) + 1) == 0
+        a.register(i, seq)
+        a.release(i)
+        a.check_invariants()
+    # 2 registered pages warm; a 3-page request must evict the OLDEST
+    seq = [200 + t for t in range(2 * PS + 1)]
+    assert a.admit(5, seq, len(seq) + 1) == 0
+    a.check_invariants()
+    a.release(5)
+    a.check_invariants()
+    # prompt 100.. survived longer than prompt 0..
+    assert a.admit(6, [100 + t for t in range(PS + 1)], PS + 2) == PS
+
+
+def test_admit_rolls_back_when_pool_too_small():
+    a = PrefixCachingAllocator(num_pages=4, page_size=PS, max_pages_per_seq=8)
+    seq = list(range(PS + 1))
+    a.admit(0, seq, len(seq) + 1)
+    a.register(0, seq)
+    a.release(0)
+    # matched 1 warm page, but 5 more pages can never materialize
+    assert a.admit(1, seq + list(range(50, 64)), 20) is None
+    a.check_invariants()
+    # the rollback left the matched page warm and admissible
+    assert a.admit(2, seq, len(seq) + 1) == PS
+
+
+def test_matched_page_in_evictable_not_double_counted():
+    """A matched warm page must count as held, not as free headroom."""
+    a = PrefixCachingAllocator(num_pages=2, page_size=PS, max_pages_per_seq=4)
+    seq = list(range(PS + 1))
+    a.admit(0, seq, len(seq) + 1)
+    a.register(0, seq)
+    a.release(0)  # 1 free + 1 evictable
+    got = a.admit(1, seq, len(seq) + 1)  # needs matched + 1 fresh
+    assert got == PS
+    a.check_invariants()
+    assert len(set(a.pages_of(1))) == 2
+
+
+def test_register_duplicate_content_keeps_one_entry():
+    a = PrefixCachingAllocator(num_pages=8, page_size=PS, max_pages_per_seq=8)
+    seq = list(range(PS + 1))
+    a.admit(0, seq, len(seq) + 1)
+    a.admit(1, seq, len(seq) + 1)  # same prompt admitted concurrently
+    a.register(0, seq)
+    a.register(1, seq)  # duplicate content: second copy stays private
+    a.check_invariants()
+    a.release(0)
+    a.release(1)
+    a.check_invariants()
+    assert a.admit(2, seq, len(seq) + 1) == PS
+
+
+def test_grow_evicts_warm_pages():
+    a = PrefixCachingAllocator(num_pages=3, page_size=PS, max_pages_per_seq=4)
+    seq = list(range(PS + 1))
+    a.admit(0, seq, len(seq) + 1)
+    a.register(0, seq)
+    a.release(0)  # 1 evictable + 1 free
+    a.admit(1, [7] * 3, 4)
+    assert a.free_pages == 2
+    fresh = a.grow(1, 3 * PS)  # needs 2 more: one comes from eviction
+    assert fresh is not None and len(fresh) == 2
+    a.check_invariants()
+
+
+def test_fuzz_invariants_random_workload():
+    rng = np.random.RandomState(0)
+    a = PrefixCachingAllocator(num_pages=24, page_size=PS,
+                               max_pages_per_seq=12)
+    live = {}
+    prompts = [list(rng.randint(0, 5, rng.randint(1, 30))) for _ in range(12)]
+    for step in range(400):
+        op = rng.randint(3)
+        if op == 0 and len(live) < 6:
+            slot = next(s for s in range(6) if s not in live)
+            seq = prompts[rng.randint(len(prompts))]
+            if a.admit(slot, seq, len(seq) + 1) is not None:
+                live[slot] = list(seq)
+        elif op == 1 and live:
+            slot = list(live)[rng.randint(len(live))]
+            seq = live[slot]
+            if a.can_grow(slot, len(seq) + 2):
+                if a.grow(slot, len(seq) + 2) is not None:
+                    seq.append(int(rng.randint(5)))
+        elif op == 2 and live:
+            slot = list(live)[rng.randint(len(live))]
+            a.register(slot, live[slot])
+            a.release(slot)
+            del live[slot]
+        a.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration (8 fake CPU devices via conftest)
+# ---------------------------------------------------------------------------
+
+def make_sched(prefix_caching: bool, **rt_kw):
+    cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    model = Model(cfg)
+    import jax
+    params = model.init(jax.random.PRNGKey(0))
+    rt = RuntimeConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                       prefix_caching=prefix_caching, **rt_kw)
+    return Scheduler(ServingEngine(model, params, rt, use_kernels=False))
+
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3, 8, 4]
+
+
+def run_one(sched, prompt, max_new=6):
+    req = sched.submit(prompt, max_new_tokens=max_new)
+    sched.run_until_done()
+    assert req.state == "finished"
+    return req.output
+
+
+def test_cached_tokens_match_uncached():
+    plain = make_sched(False)
+    cached = make_sched(True)
+    for prompt in (PROMPT, PROMPT, PROMPT[:9] + [7] * 11, [2], PROMPT):
+        assert run_one(cached, prompt) == run_one(plain, prompt), prompt
+
+
+def test_second_request_hits_cache():
+    s = make_sched(True)
+    run_one(s, PROMPT)
+    assert s.alloc.hit_tokens == 0
+    run_one(s, PROMPT)
+    # 20-token prompt, page 8: (20-1)//8 = 2 full pages hit
+    assert s.alloc.hit_tokens == 16
+    m = s.metrics()
+    assert m["prefix_cache_hit_tokens"] == 16
+    assert m["prefix_cache_lookup_tokens"] == 2 * len(PROMPT)
+
+
+def test_generated_tokens_extend_the_cache():
+    """A follow-up prompt = old prompt + old completion (multi-turn chat
+    shape) must hit pages covering the generated tokens too."""
+    s = make_sched(True)
+    out = run_one(s, PROMPT, max_new=12)
+    follow = PROMPT + out + [1, 2, 3]
+    before = s.alloc.hit_tokens
+    run_one(s, follow)
+    # everything written last round is reusable: 20+12-1 = 31 tokens
+    # -> 3 full pages (24 tokens) hit
+    assert s.alloc.hit_tokens - before == 24
+
+
+def test_concurrent_identical_prompts_share_pages():
+    s = make_sched(True)
+    done = []
+    reqs = [s.submit(PROMPT, max_new_tokens=4,
+                     on_finish=lambda r: done.append(r.id)) for _ in range(3)]
+    s.run_until_done()
+    assert len(done) == 3
+    outs = [r.output for r in reqs]
+    assert outs[0] == outs[1] == outs[2]
+    s.alloc.check_invariants()
+
+
+def test_chunked_prefill_with_prefix_caching():
+    plain = make_sched(False, prefill_chunk=16)
+    cached = make_sched(True, prefill_chunk=16)
+    long_prompt = (PROMPT * 5)[:90]
+    assert run_one(cached, long_prompt) == run_one(plain, long_prompt)
+    before = cached.alloc.hit_tokens
+    assert run_one(cached, long_prompt) == run_one(plain, long_prompt)
+    # (90-1)//8 = 11 full pages
+    assert cached.alloc.hit_tokens - before == 88
+
+
+def test_preempted_request_readmits_via_cache():
+    # pool sized so two long-decoding requests collide mid-flight
+    s = make_sched(True, num_pages=12)
+    a = s.submit(PROMPT, max_new_tokens=30)
+    b = s.submit(PROMPT[:8], max_new_tokens=30)
+    s.run_until_done()
+    assert a.state == b.state == "finished"
+    assert len(a.output) == len(b.output) == 30
+    s.alloc.check_invariants()
+    if s.metrics()["preemptions_total"]:
+        # readmission of (prompt + generated) found warm pages
+        assert s.alloc.hit_tokens > 0
+
+
+def test_parity_under_preemption_pressure():
+    plain = make_sched(False, num_pages=12)
+    cached = make_sched(True, num_pages=12)
+    for s in (plain, cached):
+        s._reqs = [s.submit(PROMPT, max_new_tokens=30),
+                   s.submit(PROMPT[:8], max_new_tokens=30)]
+        s.run_until_done()
+    for rp, rc in zip(plain._reqs, cached._reqs):
+        assert rp.output == rc.output

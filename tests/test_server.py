@@ -169,3 +169,175 @@ def test_preemption_prefers_youngest():
     assert r_old.state == "finished" and r_new.state == "finished"
     assert r_old.preemptions == 0          # the older one is never evicted
     assert r_new.preemptions > 0
+
+
+def test_max_new_tokens_alias(server):
+    out = post(server, "/generate",
+               {"prompt": "hi", "max_new_tokens": 3, "stop_token": -1})
+    assert len(out["tokens"]) == 3
+
+
+def test_openai_completions_blocking(server):
+    out = post(server, "/v1/completions",
+               {"prompt": "hi", "max_tokens": 4, "stop_token": -1})
+    assert out["object"] == "text_completion"
+    assert out["id"].startswith("cmpl-")
+    assert out["model"] == "butterfly"
+    (choice,) = out["choices"]
+    assert choice["index"] == 0 and choice["finish_reason"] == "length"
+    assert isinstance(choice["text"], str)
+    assert out["usage"]["completion_tokens"] == 4
+    assert out["usage"]["total_tokens"] == (
+        out["usage"]["prompt_tokens"] + 4)
+
+
+def test_openai_completions_token_prompt_matches_generate(server):
+    a = post(server, "/v1/completions",
+             {"prompt": [5, 7, 11], "max_tokens": 5, "stop_token": -1})
+    b = post(server, "/generate",
+             {"tokens": [5, 7, 11], "max_tokens": 5, "stop_token": -1})
+    assert a["choices"][0]["text"] == b["text"]
+
+
+def test_openai_completions_stream(server):
+    resp = post(server, "/v1/completions",
+                {"prompt": "ab", "max_tokens": 3, "stream": True,
+                 "stop_token": -1}, raw=True)
+    assert resp.headers["Content-Type"] == "text/event-stream"
+    events = []
+    for line in resp:
+        line = line.strip()
+        if line.startswith(b"data: "):
+            events.append(line[6:])
+    assert events[-1] == b"[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    # 3 token chunks + 1 final finish_reason chunk
+    assert len(chunks) == 4
+    assert all(c["object"] == "text_completion" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    assert all(c["choices"][0]["finish_reason"] is None for c in chunks[:-1])
+
+
+def test_openai_completions_rejects_multi_choice(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/v1/completions",
+             {"prompt": "hi", "max_tokens": 2, "n": 3})
+    assert e.value.code == 400
+
+
+def test_openai_completions_malformed_n_is_400(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/v1/completions",
+             {"prompt": "hi", "max_tokens": 2, "n": None})
+    assert e.value.code == 400
+
+
+def test_openai_completions_stop_token_excluded_from_text(server):
+    # discover the greedy continuation, then stop on its 3rd token
+    ref = post(server, "/generate",
+               {"tokens": [5, 7, 11], "max_tokens": 6, "stop_token": -1})
+    stop = ref["tokens"][2]
+    out = post(server, "/v1/completions",
+               {"prompt": [5, 7, 11], "max_tokens": 6, "stop_token": stop})
+    (choice,) = out["choices"]
+    assert choice["finish_reason"] == "stop"
+    # stop marker excluded from text; usage still counts it
+    from butterfly_tpu.utils.tokenizer import ByteTokenizer
+    assert choice["text"] == ByteTokenizer().decode(ref["tokens"][:2])
+    assert out["usage"]["completion_tokens"] == 3
+
+    # streaming path: the stop token's chunk is skipped too
+    resp = post(server, "/v1/completions",
+                {"prompt": [5, 7, 11], "max_tokens": 6, "stop_token": stop,
+                 "stream": True}, raw=True)
+    events = [l.strip()[6:] for l in resp if l.strip().startswith(b"data: ")]
+    assert events[-1] == b"[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    texts = [c["choices"][0]["text"] for c in chunks[:-1]]
+    assert "".join(texts) == ByteTokenizer().decode(ref["tokens"][:2])
+
+
+# -- stop sequences ---------------------------------------------------------
+
+def test_stop_matcher_unit():
+    from butterfly_tpu.serve.server import StopSequenceMatcher
+    m = StopSequenceMatcher(["END"])
+    assert m.feed("hello ") == "hello "
+    assert m.feed("E") == ""          # holdback: could grow into END
+    assert m.feed("x") == "Ex"        # not a stop after all
+    assert m.feed("EN") == ""
+    assert m.feed("D ignored") == ""  # hit: nothing past the stop leaks
+    assert m.hit
+    assert m.text[:m.released] == "hello Ex"
+
+    m2 = StopSequenceMatcher(["ab", "b"])
+    assert m2.feed("xa") == "x"       # 'a' held (prefix of 'ab')
+    assert m2.feed("b") == ""         # earliest match wins ('ab' at 1)
+    assert m2.hit and m2.text[:m2.released] == "x"
+
+    m3 = StopSequenceMatcher(["zz"])
+    assert m3.feed("az") == "a"
+    assert m3.flush() == "z"          # no hit: holdback released
+
+
+def _pieces(tokens):
+    return [ByteTokenizer().decode([t]) for t in tokens]
+
+
+def test_openai_completions_stop_sequence_blocking(server):
+    ref = post(server, "/generate",
+               {"tokens": [5, 7, 11], "max_tokens": 6, "stop_token": -1})
+    pieces = _pieces(ref["tokens"])
+    full = "".join(pieces)
+    stop = pieces[2] + pieces[3]
+    out = post(server, "/v1/completions",
+               {"prompt": [5, 7, 11], "max_tokens": 6, "stop_token": -1,
+                "stop": stop})
+    (choice,) = out["choices"]
+    assert choice["finish_reason"] == "stop"
+    assert choice["text"] == full[:full.find(stop)]
+
+
+def test_openai_completions_stop_sequence_stream(server):
+    ref = post(server, "/generate",
+               {"tokens": [5, 7, 11], "max_tokens": 6, "stop_token": -1})
+    pieces = _pieces(ref["tokens"])
+    full = "".join(pieces)
+    stop = pieces[2] + pieces[3]
+    resp = post(server, "/v1/completions",
+                {"prompt": [5, 7, 11], "max_tokens": 6, "stop_token": -1,
+                 "stop": [stop], "stream": True}, raw=True)
+    events = [l.strip()[6:] for l in resp if l.strip().startswith(b"data: ")]
+    assert events[-1] == b"[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    streamed = "".join(c["choices"][0]["text"] for c in chunks)
+    assert streamed == full[:full.find(stop)]
+
+
+def test_openai_completions_invalid_stop_is_400(server):
+    import urllib.error
+    for bad in ({"stop": 7}, {"stop": ["a", "b", "c", "d", "e"]},
+                {"stop": [1, 2]}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(server, "/v1/completions",
+                 {"prompt": "hi", "max_tokens": 2, **bad})
+        assert e.value.code == 400
+
+
+def test_openai_error_envelope_from_admit_path(server):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/v1/completions",
+             {"prompt": [999999], "max_tokens": 2})
+    assert e.value.code == 400
+    body = json.loads(e.value.read())
+    assert body["error"]["type"] == "invalid_request_error"
+    assert "out of range" in body["error"]["message"]
+    # native endpoint keeps the flat shape
+    with pytest.raises(urllib.error.HTTPError) as e2:
+        post(server, "/generate", {"tokens": [999999], "max_tokens": 2})
+    assert json.loads(e2.value.read())["error"] == "token id out of range"
