@@ -191,6 +191,17 @@ class RuntimeConfig:
     prefix_caching: bool = False      # content-hash KV page reuse across
                                       # requests (cache/prefix.py): shared
                                       # prompt prefixes skip prefill entirely
+    kv_quant: str = "none"            # "int8" stores the contiguous KV
+                                      # cache as int8 codes + per-vector
+                                      # scales: half the HBM bytes in the
+                                      # bandwidth-bound decode loop
+    decode_window: int = 0            # fused-generate write combining:
+                                      # decode this many tokens into a
+                                      # small window, flush to the cache
+                                      # in one write. 1 = per-step
+                                      # writes; 0 = auto (16 with an
+                                      # int8 cache — measured best on
+                                      # v5e — else 1)
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

@@ -56,6 +56,10 @@ class InferenceEngine:
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
         S = mesh.shape.get("stage", 1) if mesh is not None else 1
+        if self.runtime.kv_quant != "none" and S > 1:
+            raise NotImplementedError(
+                "kv_quant does not compose with pipeline stages yet "
+                "(the GPipe forward does not thread cache scales)")
         if virtual_stages > 1 and S > 1:
             # interleaved 1F1B-style schedule: permute the layer stack
             # once so each stage's contiguous shard holds its V
@@ -102,16 +106,33 @@ class InferenceEngine:
             static_argnums=(4,),
             donate_argnums=(2,),
         )
-        self._generate_fused = jax.jit(
-            partial(_generate_fused, fwd),
-            static_argnums=(4, 5),
-            donate_argnums=(2,),
-        )
+        # Fused generate: the write-combined window variant decodes
+        # decode_window tokens per outer scan step and flushes them into
+        # the cache in one ragged write (models/common.py window docs);
+        # the per-step variant remains for pipeline meshes (the GPipe
+        # forward manages its own cache writes) and decode_window=1.
+        window = self.runtime.decode_window
+        if window == 0:  # auto (config.py rationale)
+            window = 16 if self.runtime.kv_quant == "int8" else 1
+        self._decode_window = max(1, window) if S <= 1 else 1
+        if self._decode_window > 1:
+            self._generate_fused = jax.jit(
+                partial(_generate_fused_win, self.cfg, self._decode_window),
+                static_argnums=(4, 5),
+                donate_argnums=(2,),
+            )
+        else:
+            self._generate_fused = jax.jit(
+                partial(_generate_fused, fwd),
+                static_argnums=(4, 5),
+                donate_argnums=(2,),
+            )
 
     # -- public API ---------------------------------------------------------
 
     def new_cache(self, batch: int, max_seq: Optional[int] = None) -> KVCache:
-        return init_cache(self.cfg, batch, max_seq or self.runtime.max_seq_len)
+        return init_cache(self.cfg, batch, max_seq or self.runtime.max_seq_len,
+                          quant=self.runtime.kv_quant)
 
     def prefill(self, tokens: jax.Array, true_lens: jax.Array,
                 cache: KVCache) -> Tuple[jax.Array, KVCache]:
@@ -143,7 +164,10 @@ class InferenceEngine:
                 f"prompt ({tokens.shape[1]}) + max_new_tokens "
                 f"({sp.max_new_tokens}) = {total} exceeds the model's "
                 f"max_seq_len ({self.cfg.max_seq_len})")
-        max_seq = max(self.runtime.max_seq_len, total)
+        # windowed fused decode rounds the step count up to a multiple of
+        # the window; the tail steps write (frozen) tokens past `total`
+        max_seq = max(self.runtime.max_seq_len,
+                      total + self._decode_window - 1)
         cache = self.new_cache(B, max_seq)
         if self.mesh is not None:
             from butterfly_tpu.parallel.partition import shard_cache
@@ -190,7 +214,7 @@ def _prefill_step(fwd, params, tokens, cache, true_lens):
     logits, cache = fwd(params, tokens, cache, positions)
     # gather last *real* token's logits; fix per-seq lengths
     last = jnp.take_along_axis(logits, (true_lens - 1)[:, None, None], axis=1)
-    cache = KVCache(cache.k, cache.v, true_lens.astype(jnp.int32))
+    cache = cache._replace(length=true_lens.astype(jnp.int32))
     return last[:, 0, :], cache
 
 
@@ -228,6 +252,56 @@ def _generate_fused(fwd, params, first, cache, key,
     # The final cache is returned (and ignored by callers) purely so the
     # donated input cache has an output to alias — otherwise XLA keeps a
     # second full KV pool live for the whole scan.
+    return out, lens, cache
+
+
+def _generate_fused_win(cfg: ModelConfig, C: int, params, first, cache, key,
+                        sp: SamplingParams, max_new: int):
+    """Write-combined fused generate: C decode steps per outer scan
+    iteration against (cache + window + self), then ONE ragged cache
+    write for all C tokens (flush_window). Token-for-token identical to
+    _generate_fused — the window stores the cache's exact representation
+    (int8 codes + scales in quant mode) and keys split in the same
+    order — while amortizing the dominant whole-pool copy the per-step
+    cache update costs on TPU (models/common.py window docs).
+    """
+    from butterfly_tpu.models.common import (
+        decode_step_win, decode_window_init, flush_window, window_insert)
+
+    B = first.shape[0]
+    steps = max_new - 1
+    iters = -(-steps // C) if steps else 0
+    win = decode_window_init(cfg, B, C, cache.quantized,
+                             dtype=None if cache.quantized
+                             else cache.k.dtype)
+    quant = cache.quantized
+
+    def body(carry, _):
+        cur, cache, wk, wv, wk_s, wv_s, key, done = carry
+        toks = []
+        for j in range(C):
+            key, sub = jax.random.split(key)
+            logits, new_kv = decode_step_win(
+                params, cfg, cur[:, None], cache, wk, wv, wk_s, wv_s, j)
+            wk, wv, wk_s, wv_s = window_insert(
+                cfg, quant, wk, wv, wk_s, wv_s, new_kv, j)
+            nxt = sample(logits[:, -1, :], sub, sp)
+            nxt = jnp.where(done, cur, nxt)
+            if sp.stop_token >= 0:
+                done = done | (nxt == sp.stop_token)
+            cur = nxt
+            toks.append(nxt)
+        cache = flush_window(cache, wk, wv, wk_s, wv_s)
+        return (cur, cache, wk, wv, wk_s, wv_s, key, done), jnp.stack(toks)
+
+    done0 = (first == sp.stop_token) if sp.stop_token >= 0 \
+        else jnp.zeros_like(first, dtype=bool)
+    carry0 = (first, cache, *win, key, done0)
+    (_, cache, *_), toks = jax.lax.scan(body, carry0, None, length=iters)
+    toks = toks.reshape(iters * C, B)[:steps] if steps \
+        else jnp.zeros((0, B), first.dtype)
+    out = jnp.concatenate([first[:, None], toks.T], axis=1)  # [B, max_new]
+    lens = _stop_lengths_jnp(out, sp.stop_token)
     return out, lens, cache
 
 

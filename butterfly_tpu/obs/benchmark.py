@@ -42,14 +42,15 @@ def _chip_lookup(table: Dict[str, float], default: float) -> float:
 
 def run_decode_benchmark(model, params, batch: int, prompt_len: int,
                          max_new: int, seed: int = 0,
-                         mesh=None) -> Dict:
+                         mesh=None, kv_quant: str = "none") -> Dict:
     import jax
     import jax.numpy as jnp
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine import InferenceEngine, SamplingParams
 
     engine = InferenceEngine(
-        model, params, RuntimeConfig(max_seq_len=prompt_len + max_new),
+        model, params, RuntimeConfig(max_seq_len=prompt_len + max_new,
+                                     kv_quant=kv_quant),
         mesh=mesh)
     rng = np.random.RandomState(seed)
     prompts = rng.randint(1, model.cfg.vocab_size,
@@ -82,8 +83,12 @@ def run_decode_benchmark(model, params, batch: int, prompt_len: int,
     param_bytes = sum(x.nbytes for x in leaves)
     param_count = sum(x.size for x in leaves)
     S = prompt_len + max_new
-    kv_bytes = (2 * cfg.num_layers * batch * S * cfg.num_kv_heads *
-                cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    # bytes per stored K/V vector: head_dim * itemsize, +4 for the f32
+    # per-vector scale in int8 mode
+    vec_bytes = cfg.head_dim * (1 if kv_quant == "int8"
+                                else jnp.dtype(cfg.dtype).itemsize) \
+        + (4 if kv_quant == "int8" else 0)
+    kv_bytes = 2 * cfg.num_layers * batch * S * cfg.num_kv_heads * vec_bytes
     n_chips = mesh.size if mesh is not None else 1
     dp = mesh.shape.get("data", 1) if mesh is not None else 1
     bytes_per_step = param_bytes * dp + kv_bytes

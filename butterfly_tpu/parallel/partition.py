@@ -125,13 +125,22 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Specs:
     return specs
 
 
-def cache_specs(cfg: ModelConfig, mesh: Mesh) -> KVCache:
-    """Specs for the KVCache pytree [L,B,S,Kv,H]: layers x stage (mirrors
-    the param layout so each pipeline stage holds only its own layers'
-    cache), batch x data, kv-heads x tensor."""
-    kv = P(_div(cfg.num_layers, mesh, "stage"), _div_any(mesh, "data"), None,
-           _div(cfg.num_kv_heads, mesh, "tensor"), None)
-    return KVCache(k=kv, v=kv, length=P(_div_any(mesh, "data")))
+def cache_specs(cfg: ModelConfig, mesh: Mesh, quant: bool = False) -> KVCache:
+    """Specs for the KVCache pytree: layers x stage (mirrors the param
+    layout so each pipeline stage holds only its own layers' cache),
+    batch x data, kv-heads x tensor. Float caches are [L,B,S,Kv,H];
+    int8 caches are [L,B,Kv,S,H] + scale leaves [L,B,Kv,S] (see
+    models.common.KVCache for why the dim orders differ)."""
+    lspec = _div(cfg.num_layers, mesh, "stage")
+    dspec = _div_any(mesh, "data")
+    tspec = _div(cfg.num_kv_heads, mesh, "tensor")
+    if quant:
+        kv = P(lspec, dspec, tspec, None, None)
+        sc = P(lspec, dspec, tspec, None)
+    else:
+        kv = P(lspec, dspec, None, tspec, None)
+        sc = None
+    return KVCache(k=kv, v=kv, length=P(dspec), k_scale=sc, v_scale=sc)
 
 
 def _div_any(mesh: Mesh, axis: str) -> Optional[str]:
@@ -190,7 +199,8 @@ def shard_params(params, cfg: ModelConfig, mesh: Mesh):
 
 
 def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
-    return jax.device_put(cache, to_shardings(cache_specs(cfg, mesh), mesh))
+    return jax.device_put(cache, to_shardings(
+        cache_specs(cfg, mesh, quant=cache.quantized), mesh))
 
 
 # ---------------------------------------------------------------------------

@@ -38,8 +38,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="weight-only quantization (int8 halves the "
                              "HBM bytes the decode loop streams)")
 
+    def kv_quant_flag(sp):
+        # generate/bench only: the paged serving cache has no int8 path
+        # yet, so `serve` deliberately does not take the flag
+        sp.add_argument("--kv-quant", choices=["none", "int8"],
+                        default="none",
+                        help="KV-cache quantization (int8 halves the cache "
+                             "bytes — the dominant decode-loop term at "
+                             "serving batch sizes)")
+
     g = sub.add_parser("generate", help="one-shot text generation")
     common(g)
+    kv_quant_flag(g)
     g.add_argument("--prompt", default="Hello")
     g.add_argument("--max-new", type=int, default=64)
     g.add_argument("--temperature", type=float, default=0.0)
@@ -64,6 +74,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)
+    kv_quant_flag(b)
     b.add_argument("--batch", type=int, default=8)
     b.add_argument("--prompt-len", type=int, default=128)
     b.add_argument("--max-new", type=int, default=128)
@@ -148,9 +159,11 @@ def cmd_generate(args) -> int:
     tok = load_tokenizer(args.tokenizer or args.ckpt)
     mesh = build_mesh(args)
     params = shard_for_mesh(load_params(model, args), model.cfg, mesh)
-    engine = InferenceEngine(model, params,
-                             runtime=RuntimeConfig(max_seq_len=args.max_seq),
-                             mesh=mesh)
+    engine = InferenceEngine(
+        model, params,
+        runtime=RuntimeConfig(max_seq_len=args.max_seq,
+                              kv_quant=args.kv_quant),
+        mesh=mesh)
     vocab = model.cfg.vocab_size
     stop = tok.eos_id if tok.eos_id is not None and tok.eos_id < vocab else -1
     sp = SamplingParams(temperature=args.temperature, top_k=args.top_k,
@@ -186,7 +199,8 @@ def cmd_bench(args) -> int:
     params = shard_for_mesh(load_params(model, args), model.cfg, mesh)
     stats = run_decode_benchmark(model, params, batch=args.batch,
                                  prompt_len=args.prompt_len,
-                                 max_new=args.max_new, mesh=mesh)
+                                 max_new=args.max_new, mesh=mesh,
+                                 kv_quant=args.kv_quant)
     print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/sec/chip", **stats}))
