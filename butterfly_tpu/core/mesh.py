@@ -51,6 +51,57 @@ def mesh_for(n_devices: int, tensor: int = 0, stage: int = 1, expert: int = 1,
     return make_mesh(cfg, devices=jax.devices()[:n_devices])
 
 
+def slice_groups(devices: Sequence[jax.Device]) -> dict:
+    """Group devices by TPU slice (DCN island). Devices without a
+    slice_index (CPU, single-slice) all land in slice 0."""
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return groups
+
+
+def make_hybrid_mesh(cfg: MeshConfig,
+                     devices: Optional[Sequence[jax.Device]] = None,
+                     dcn_axes: Sequence[str] = ("data",)) -> Mesh:
+    """Mesh for multi-slice deployments: `dcn_axes` span slices (over
+    DCN), every other axis stays inside one slice (over ICI).
+
+    The scaling-book recipe: collectives that run every layer (tensor,
+    expert, seq all-reduces / all-to-alls) must ride ICI, so only the
+    low-traffic axes — `data` by default, optionally `stage` whose
+    ppermute handoff crosses a slice boundary once per microbatch — may
+    be placed across slices. Single-slice (or CPU) device sets fall
+    back to the plain ICI mesh, so callers can use this unconditionally.
+    """
+    if devices is None:
+        devices = jax.devices()
+    groups = slice_groups(devices)
+    if len(groups) == 1:
+        return make_mesh(cfg, devices)
+
+    sizes = dict(zip(MESH_AXES, cfg.axis_sizes))
+    bad = [a for a in dcn_axes if a not in MESH_AXES]
+    if bad:
+        raise ValueError(f"unknown mesh axes {bad}")
+    dcn_shape = [sizes[a] if a in dcn_axes else 1 for a in MESH_AXES]
+    ici_shape = [1 if a in dcn_axes else sizes[a] for a in MESH_AXES]
+    n_dcn = int(np.prod(dcn_shape))
+    per_slice = int(np.prod(ici_shape))
+    if n_dcn != len(groups):
+        raise ValueError(
+            f"dcn axes {tuple(dcn_axes)} have total size {n_dcn} but the "
+            f"job spans {len(groups)} slices")
+    if any(len(g) != per_slice for g in groups.values()):
+        raise ValueError(
+            f"each slice must contribute {per_slice} devices "
+            f"(got {[len(g) for g in groups.values()]})")
+    from jax.experimental import mesh_utils
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=devices,
+        allow_split_physical_axes=True)
+    return Mesh(dev_array, MESH_AXES)
+
+
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:

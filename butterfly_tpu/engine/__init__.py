@@ -1,2 +1,3 @@
-from butterfly_tpu.engine.engine import InferenceEngine, GenerateResult  # noqa: F401
+from butterfly_tpu.engine.engine import (  # noqa: F401
+    GenerateResult, InferenceEngine, SpeculativeResult)
 from butterfly_tpu.engine.sampling import SamplingParams, sample  # noqa: F401

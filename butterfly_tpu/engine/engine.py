@@ -36,6 +36,33 @@ class GenerateResult:
     prompt_lengths: np.ndarray  # [B]
 
 
+@dataclass
+class SpeculativeResult:
+    tokens: np.ndarray       # [n] generated ids (stop-truncated)
+    forwards: int            # device forwards taken (prefill + verifies)
+    accepted_drafts: int     # draft tokens accepted across all verifies
+
+    @property
+    def tokens_per_forward(self) -> float:
+        return len(self.tokens) / max(1, self.forwards)
+
+
+def _ngram_draft(history, gamma: int, ngram: int):
+    """Prompt-lookup draft: find the most recent earlier occurrence of
+    the trailing `ngram` tokens and propose what followed it. Pads with
+    zeros on no match / short continuation (padding simply gets
+    rejected by the verify step — no special casing)."""
+    draft = []
+    if len(history) > ngram:
+        tail = history[-ngram:]
+        # scan right-to-left for the most recent match
+        for i in range(len(history) - ngram - 1, -1, -1):
+            if history[i:i + ngram] == tail:
+                draft = history[i + ngram:i + ngram + gamma]
+                break
+    return draft + [0] * (gamma - len(draft))
+
+
 class InferenceEngine:
     """Single-program inference over a (possibly sharded) param pytree.
 
@@ -197,6 +224,116 @@ class InferenceEngine:
                 out = _mask_after_stop(out, lens, sp.stop_token)
         return GenerateResult(tokens=out[:n_real], lengths=lens[:n_real],
                               prompt_lengths=np.asarray(true_lens)[:n_real])
+
+    def generate_speculative(self, prompt: Sequence[int],
+                             sp: Optional[SamplingParams] = None,
+                             gamma: int = 4, ngram: int = 2
+                             ) -> "SpeculativeResult":
+        """Greedy generation with prompt-lookup speculative decoding.
+
+        Drafts `gamma` tokens per step by matching the last `ngram`
+        generated tokens against the sequence so far (the model-free
+        "prompt lookup" scheme) and verifies the whole draft in ONE
+        (gamma+1)-token warm forward. Accepted drafts advance the
+        sequence several tokens per forward; output is token-for-token
+        IDENTICAL to plain greedy decode — speculation only changes how
+        many forwards it takes, never what they produce.
+
+        Correctness of the KV cache under rejection: a verify step
+        writes K/V for every draft position; rejected positions hold
+        stale K/V, but the next verify starts at the first rejected
+        position and rewrites all of them before any query can attend
+        that far (write-then-attend in attention_block), so stale
+        entries are never visible.
+
+        Single-sequence, host-looped (per-row accept counts diverge, so
+        this is not batched); greedy only — stochastic speculative
+        sampling would need the rejection-sampling correction.
+        """
+        sp = sp or SamplingParams()
+        if not sp.is_greedy:
+            raise NotImplementedError(
+                "speculative decoding is greedy-only (temperature=0)")
+        if gamma < 1 or ngram < 1:
+            raise ValueError("gamma and ngram must be >= 1")
+        if self.mesh is not None and (self.mesh.shape.get("data", 1) > 1
+                                      or self.mesh.shape.get("stage", 1) > 1):
+            # one sequence can't be data-sharded, and the GPipe forward
+            # has no single-microbatch warm-verify path
+            raise NotImplementedError(
+                "speculative decoding supports tensor/expert meshes only")
+
+        tokens, true_lens = pad_prompts([list(prompt)])
+        total = tokens.shape[1] + sp.max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds max_seq_len")
+        # + gamma slack: the last verify may write past `total`
+        cache = self.new_cache(1, max(self.runtime.max_seq_len,
+                                      total + gamma))
+        if self.mesh is not None:
+            from butterfly_tpu.parallel.partition import shard_cache
+            cache = shard_cache(cache, self.cfg, self.mesh)
+
+        with self._mesh_ctx():
+            logits, cache = self.prefill(jnp.asarray(tokens),
+                                         jnp.asarray(true_lens), cache)
+            cur = int(jnp.argmax(logits[0]))
+        history = list(prompt) + [cur]
+        out = [cur]
+        forwards = 1  # the prefill produced the first token
+        accepted_total = 0
+
+        verify = self._verify_program(gamma)
+        while len(out) < sp.max_new_tokens and \
+                not (sp.stop_token >= 0 and out[-1] == sp.stop_token):
+            draft = _ngram_draft(history, gamma, ngram)
+            pos0 = len(history) - 1  # cur's absolute position
+            toks = jnp.asarray([[cur] + draft], jnp.int32)
+            positions = pos0 + jnp.arange(gamma + 1)[None, :]
+            with self._mesh_ctx():
+                greedy, cache = verify(self.params, toks, cache, positions)
+            greedy = np.asarray(greedy[0])  # [gamma+1]
+            forwards += 1
+
+            emitted = [int(greedy[0])]
+            for i in range(gamma):
+                if draft[i] != int(greedy[i]):
+                    break
+                emitted.append(int(greedy[i + 1]))
+            accepted_total += len(emitted) - 1
+            # valid cache entries: cur + the accepted drafts
+            new_len = pos0 + len(emitted)
+            cache = cache._replace(
+                length=jnp.asarray([new_len], jnp.int32))
+            for t in emitted:
+                out.append(t)
+                history.append(t)
+                if len(out) >= sp.max_new_tokens or \
+                        (sp.stop_token >= 0 and t == sp.stop_token):
+                    break
+            cur = out[-1]
+
+        if sp.stop_token >= 0 and sp.stop_token in out:
+            out = out[:out.index(sp.stop_token) + 1]
+        return SpeculativeResult(
+            tokens=np.asarray(out, np.int32), forwards=forwards,
+            accepted_drafts=accepted_total)
+
+    def _verify_program(self, gamma: int):
+        """jitted (gamma+1)-token warm verify: returns per-position
+        greedy next tokens [B, gamma+1]. Cached per gamma."""
+        if not hasattr(self, "_verify_cache"):
+            self._verify_cache = {}
+        if gamma not in self._verify_cache:
+            fwd = self._fwd
+
+            def step(params, toks, cache, positions):
+                logits, cache = fwd(params, toks, cache, positions)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            self._verify_cache[gamma] = jax.jit(step, donate_argnums=(2,))
+        return self._verify_cache[gamma]
 
     def _mesh_ctx(self):
         import contextlib

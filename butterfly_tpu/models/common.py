@@ -564,6 +564,38 @@ def decode_attend(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     return out.reshape(B, 1, Nq, H)
 
 
+def _decode_layer_body(x, lp, cfg: ModelConfig, cache: KVCache, i,
+                       cos, sin, start, wk=None, wv=None, wk_s=None,
+                       wv_s=None, wlen=None):
+    """One decode layer against layer `i`'s slice of the closed-over
+    cache (+ optional write-combining window slice). The single layer
+    body shared by _decode_forward and decode_step_win so the per-step
+    and windowed decode paths cannot drift. Returns (x, k_new, v_new)
+    with k/v [B,1,Kv,H] in compute dtype.
+    """
+    compute_dtype = jnp.dtype(cfg.dtype)
+    lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
+    ck = lax.dynamic_index_in_dim(cache.k, i, 0, keepdims=False)
+    cv = lax.dynamic_index_in_dim(cache.v, i, 0, keepdims=False)
+    k_s = v_s = wk_i = wv_i = wks_i = wvs_i = None
+    if cache.quantized:
+        k_s = lax.dynamic_index_in_dim(cache.k_scale, i, 0, keepdims=False)
+        v_s = lax.dynamic_index_in_dim(cache.v_scale, i, 0, keepdims=False)
+        if wk_s is not None:
+            wks_i = lax.dynamic_index_in_dim(wk_s, i, 0, keepdims=False)
+            wvs_i = lax.dynamic_index_in_dim(wv_s, i, 0, keepdims=False)
+    if wk is not None:
+        wk_i = lax.dynamic_index_in_dim(wk, i, 0, keepdims=False)
+        wv_i = lax.dynamic_index_in_dim(wv, i, 0, keepdims=False)
+    h = pre_norm(x, lp["ln1"], cfg)
+    q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+    out = decode_attend(q, k, v, ck, cv, start, cfg, k_s, v_s,
+                        wk_i, wv_i, wks_i, wvs_i, wlen)
+    x = x + attn_output(out, lp["attn"], cfg)
+    x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+    return x, k, v
+
+
 def _decode_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
                     cache: KVCache, positions: jax.Array
                     ) -> Tuple[jax.Array, KVCache]:
@@ -577,7 +609,6 @@ def _decode_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     B = tokens.shape[0]
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     start = positions[:, 0]
-    compute_dtype = jnp.dtype(cfg.dtype)
     quant = cache.quantized
 
     # The cache is READ-ONLY inside the layer scan (writes are deferred
@@ -588,20 +619,7 @@ def _decode_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     # the v5e fused-generate trace.
     def layer(carry, lp):
         x, i = carry
-        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
-        ck = lax.dynamic_index_in_dim(cache.k, i, 0, keepdims=False)
-        cv = lax.dynamic_index_in_dim(cache.v, i, 0, keepdims=False)
-        k_s = v_s = None
-        if quant:
-            k_s = lax.dynamic_index_in_dim(cache.k_scale, i, 0,
-                                           keepdims=False)
-            v_s = lax.dynamic_index_in_dim(cache.v_scale, i, 0,
-                                           keepdims=False)
-        h = pre_norm(x, lp["ln1"], cfg)
-        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-        out = decode_attend(q, k, v, ck, cv, start, cfg, k_s, v_s)
-        x = x + attn_output(out, lp["attn"], cfg)
-        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        x, k, v = _decode_layer_body(x, lp, cfg, cache, i, cos, sin, start)
         if quant:
             kq, ksc = quantize_kv(k)
             vq, vsc = quantize_kv(v)
@@ -683,29 +701,11 @@ def decode_step_win(params: Params, cfg: ModelConfig, tokens: jax.Array,
     positions = (cache.length + wstep)[:, None]
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     start = cache.length
-    compute_dtype = jnp.dtype(cfg.dtype)
 
     def layer(carry, lp):
         x, i = carry
-        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
-        ck = lax.dynamic_index_in_dim(cache.k, i, 0, keepdims=False)
-        cv = lax.dynamic_index_in_dim(cache.v, i, 0, keepdims=False)
-        k_s = v_s = wks_i = wvs_i = None
-        if quant:
-            k_s = lax.dynamic_index_in_dim(cache.k_scale, i, 0,
-                                           keepdims=False)
-            v_s = lax.dynamic_index_in_dim(cache.v_scale, i, 0,
-                                           keepdims=False)
-            wks_i = lax.dynamic_index_in_dim(wk_s, i, 0, keepdims=False)
-            wvs_i = lax.dynamic_index_in_dim(wv_s, i, 0, keepdims=False)
-        wk_i = lax.dynamic_index_in_dim(wk, i, 0, keepdims=False)
-        wv_i = lax.dynamic_index_in_dim(wv, i, 0, keepdims=False)
-        h = pre_norm(x, lp["ln1"], cfg)
-        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-        out = decode_attend(q, k, v, ck, cv, start, cfg, k_s, v_s,
-                            wk_i, wv_i, wks_i, wvs_i, wstep)
-        x = x + attn_output(out, lp["attn"], cfg)
-        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        x, k, v = _decode_layer_body(x, lp, cfg, cache, i, cos, sin, start,
+                                     wk, wv, wk_s, wv_s, wstep)
         if quant:
             kq, ksc = quantize_kv(k)
             vq, vsc = quantize_kv(v)

@@ -34,6 +34,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--expert-parallel", type=int, default=1)
         sp.add_argument("--data-parallel", type=int, default=1)
         sp.add_argument("--max-seq", type=int, default=2048)
+        sp.add_argument("--dcn-axes", default="data",
+                        help="comma list of mesh axes to place ACROSS TPU "
+                             "slices (DCN) on multi-slice jobs; all other "
+                             "axes stay within a slice on ICI "
+                             "(e.g. 'data' or 'data,stage')")
         sp.add_argument("--quant", choices=["none", "int8"], default="none",
                         help="weight-only quantization (int8 halves the "
                              "HBM bytes the decode loop streams)")
@@ -56,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--top-k", type=int, default=0)
     g.add_argument("--top-p", type=float, default=1.0)
     g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
+                   help="prompt-lookup speculative decoding: draft GAMMA "
+                        "tokens per step, verify in one forward (greedy "
+                        "only; output identical to plain decode)")
 
     s = sub.add_parser("serve", help="HTTP serving with continuous batching")
     common(s)
@@ -120,7 +129,7 @@ def build_mesh(args):
     """
     import jax
     from butterfly_tpu.core.config import MeshConfig
-    from butterfly_tpu.core.mesh import init_distributed, make_mesh
+    from butterfly_tpu.core.mesh import init_distributed, make_hybrid_mesh
 
     tp = getattr(args, "tensor_parallel", 1)
     pp = getattr(args, "stage_parallel", 1)
@@ -137,7 +146,14 @@ def build_mesh(args):
             f"--expert-parallel {ep} x --data-parallel {dp} = {n} devices, "
             f"but only {ndev} are available")
     cfg = MeshConfig(data=dp, stage=pp, expert=ep, tensor=tp)
-    return make_mesh(cfg, jax.devices()[:n])
+    # hybrid: on a multi-slice job the --dcn-axes span slices over DCN
+    # and every per-layer collective stays on ICI; single-slice device
+    # sets (and CPU) fall back to the plain mesh inside
+    dcn = tuple(a for a in getattr(args, "dcn_axes", "data").split(",") if a)
+    try:
+        return make_hybrid_mesh(cfg, jax.devices()[:n], dcn_axes=dcn)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}")
 
 
 def shard_for_mesh(params, cfg, mesh):
@@ -176,6 +192,24 @@ def cmd_generate(args) -> int:
               f"vocab ({vocab}); pass a matching --tokenizer", file=sys.stderr)
         return 2
     t0 = time.perf_counter()
+    if args.speculate > 0:
+        if args.temperature > 0:
+            print("error: --speculate requires greedy decoding "
+                  "(--temperature 0)", file=sys.stderr)
+            return 2
+        try:
+            res = engine.generate_speculative(ids, sp, gamma=args.speculate)
+        except NotImplementedError as e:  # e.g. data/stage-parallel mesh
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        dt = time.perf_counter() - t0
+        n = len(res.tokens)
+        text = tok.decode(res.tokens.tolist())
+        print(text)
+        print(f"[butterfly] {n} tokens in {dt:.2f}s via {res.forwards} "
+              f"forwards ({res.tokens_per_forward:.2f} tok/forward, "
+              f"{res.accepted_drafts} drafts accepted)", file=sys.stderr)
+        return 0
     res = engine.generate([ids], sp, seed=args.seed)
     dt = time.perf_counter() - t0
     n = int(res.lengths[0])

@@ -1,0 +1,144 @@
+"""Prompt-lookup speculative decoding (engine.generate_speculative).
+
+The only contract that matters: output tokens are IDENTICAL to plain
+greedy decode — speculation changes how many forwards a generation
+takes, never what it produces. Parity is pinned across prompts,
+gammas, stop tokens, and the int8 KV cache; the acceptance machinery
+is additionally exercised on a looping continuation where drafts
+actually hit.
+"""
+import jax
+import numpy as np
+import pytest
+
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.engine import InferenceEngine, SamplingParams
+from butterfly_tpu.engine.engine import _ngram_draft
+from butterfly_tpu.models.common import Model
+
+CFG = tiny("llama", dtype="float32", param_dtype="float32")
+
+
+def make_engine(**rt):
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(3))
+    return InferenceEngine(model, params, RuntimeConfig(**rt))
+
+
+def ref_tokens(eng, prompt, sp):
+    res = eng.generate([prompt], sp)
+    return res.tokens[0, :int(res.lengths[0])].tolist()
+
+
+def test_ngram_draft_lookup():
+    #          0  1  2  3  4  5  6  7
+    history = [5, 9, 2, 7, 1, 5, 9, 4]
+    # tail [9,4] has no earlier occurrence -> zero padding
+    assert _ngram_draft(history, 3, 2) == [0, 0, 0]
+    # tail [5,9] in [5,9,2,7,1,5,9,5,9]: most recent earlier match is at
+    # index 5 -> continuation [5,9], padded
+    assert _ngram_draft(history[:-1] + [5, 9], 3, 2) == [5, 9, 0]
+    # with only the index-0 occurrence, its continuation is drafted
+    assert _ngram_draft([5, 9, 2, 7, 1, 5, 9], 3, 2) == [2, 7, 1]
+    # short continuation pads
+    assert _ngram_draft([1, 2, 1, 2], 4, 2)[:2] == [1, 2]
+
+
+def test_parity_with_plain_greedy():
+    eng = make_engine(max_seq_len=128)
+    sp = SamplingParams(max_new_tokens=24)
+    for prompt in ([5, 7, 11], [2], list(range(1, 17)), [3, 3, 3, 3, 3]):
+        want = ref_tokens(eng, prompt, sp)
+        for gamma in (1, 3, 5):
+            got = eng.generate_speculative(prompt, sp, gamma=gamma)
+            assert got.tokens.tolist() == want, (prompt, gamma)
+
+
+def test_parity_with_stop_token():
+    eng = make_engine(max_seq_len=128)
+    base = ref_tokens(eng, [5, 7, 11], SamplingParams(max_new_tokens=24))
+    stop = base[10]
+    sp = SamplingParams(max_new_tokens=24, stop_token=stop)
+    want = ref_tokens(eng, [5, 7, 11], sp)
+    got = eng.generate_speculative([5, 7, 11], sp, gamma=4)
+    assert got.tokens.tolist() == want
+    assert got.tokens.tolist()[-1] == stop
+
+
+def test_accepts_drafts_on_repetitive_continuation():
+    """Greedy decode from a tiny random model settles into a loop (the
+    prompt-lookup sweet spot); with the looping continuation seeded in
+    the prompt, verifies must accept drafts and finish in far fewer
+    forwards than tokens."""
+    eng = make_engine(max_seq_len=256)
+    sp0 = SamplingParams(max_new_tokens=32)
+    cont = ref_tokens(eng, [5, 7, 11], sp0)
+    # seed the prompt with the model's own continuation: drafts now hit
+    prompt = [5, 7, 11] + cont
+    sp = SamplingParams(max_new_tokens=32)
+    want = ref_tokens(eng, prompt, sp)
+    got = eng.generate_speculative(prompt, sp, gamma=4)
+    assert got.tokens.tolist() == want
+    assert got.accepted_drafts > 0
+    assert got.forwards < 1 + len(want)  # beat one-forward-per-token
+
+
+def test_parity_with_int8_kv_cache():
+    eng = make_engine(max_seq_len=128, kv_quant="int8")
+    sp = SamplingParams(max_new_tokens=16)
+    want = ref_tokens(eng, [5, 7, 11, 2], sp)
+    got = eng.generate_speculative([5, 7, 11, 2], sp, gamma=3)
+    assert got.tokens.tolist() == want
+
+
+def test_rejects_sampling():
+    eng = make_engine(max_seq_len=64)
+    with pytest.raises(NotImplementedError):
+        eng.generate_speculative([1], SamplingParams(temperature=0.7))
+
+
+def test_cli_speculate_flag():
+    from butterfly_tpu.serve.cli import main
+    assert main(["generate", "--model", "tiny", "--prompt", "hello",
+                 "--max-new", "8", "--speculate", "4"]) == 0
+
+
+def test_parity_on_tensor_mesh():
+    from butterfly_tpu.core.config import MeshConfig
+    from butterfly_tpu.core.mesh import make_mesh
+    from butterfly_tpu.parallel.partition import shard_params
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(3))
+    ref = InferenceEngine(model, params, RuntimeConfig(max_seq_len=128))
+    sp = SamplingParams(max_new_tokens=12)
+    want = ref_tokens(ref, [5, 7, 11], sp)
+
+    mesh = make_mesh(MeshConfig(tensor=4), jax.devices()[:4])
+    eng = InferenceEngine(model, shard_params(params, CFG, mesh),
+                          RuntimeConfig(max_seq_len=128), mesh=mesh)
+    got = eng.generate_speculative([5, 7, 11], sp, gamma=3)
+    assert got.tokens.tolist() == want
+
+
+def test_rejects_data_parallel_mesh():
+    from butterfly_tpu.core.config import MeshConfig
+    from butterfly_tpu.core.mesh import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 fake devices")
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(3))
+    mesh = make_mesh(MeshConfig(data=2), jax.devices()[:2])
+    eng = InferenceEngine(model, params, RuntimeConfig(max_seq_len=64),
+                          mesh=mesh)
+    with pytest.raises(NotImplementedError):
+        eng.generate_speculative([1, 2], SamplingParams(max_new_tokens=4))
+
+
+def test_cli_speculate_rejects_sampling():
+    from butterfly_tpu.serve.cli import main
+    assert main(["generate", "--model", "tiny", "--prompt", "x",
+                 "--max-new", "4", "--speculate", "2",
+                 "--temperature", "0.5"]) == 2
