@@ -23,6 +23,9 @@ HELP = {
     "kv_pages_total": "Total usable KV-cache pages",
     "ttft_p50": "p50 time-to-first-token (seconds)",
     "ttft_p95": "p95 time-to-first-token (seconds)",
+    "itl_p50": "p50 inter-token latency (seconds)",
+    "itl_p95": "p95 inter-token latency (seconds)",
+    "itl_max": "max inter-token latency in the recent window (seconds)",
     "tokens_per_sec": "Decode throughput over the last window",
     "uptime_seconds": "Server uptime",
     "prefix_cache_hit_tokens": "Prompt tokens served from the prefix cache",

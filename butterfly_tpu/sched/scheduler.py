@@ -55,6 +55,7 @@ class Request:
     preemptions: int = 0
     t_arrive: float = field(default_factory=time.monotonic)
     t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
     t_finish: Optional[float] = None
     on_token: Optional[Callable[["Request", int], None]] = None
     on_finish: Optional[Callable[["Request"], None]] = None
@@ -105,6 +106,11 @@ class Scheduler:
             "tokens_generated_total": 0, "preemptions_total": 0,
         }
         self._ttfts: List[float] = []
+        # inter-token gaps (seconds), bounded reservoir of the most
+        # recent gaps across all requests — the latency a decoding
+        # request experiences when admissions interleave (the quantity
+        # chunked prefill exists to bound)
+        self._itls: Deque[float] = deque(maxlen=4096)
 
     # -- public API ---------------------------------------------------------
 
@@ -208,6 +214,11 @@ class Scheduler:
             a = np.asarray(self._ttfts)
             m["ttft_p50"] = float(np.percentile(a, 50))
             m["ttft_p95"] = float(np.percentile(a, 95))
+        if self._itls:
+            a = np.asarray(self._itls)
+            m["itl_p50"] = float(np.percentile(a, 50))
+            m["itl_p95"] = float(np.percentile(a, 95))
+            m["itl_max"] = float(a.max())
         return m
 
     # -- internals ----------------------------------------------------------
@@ -309,6 +320,9 @@ class Scheduler:
         if req.t_first_token is None:
             req.t_first_token = now
             self._ttfts.append(req.ttft)
+        else:
+            self._itls.append(now - req.t_last_token)
+        req.t_last_token = now
         req.output.append(token)
         self._metrics["tokens_generated_total"] += 1
         if req.on_token is not None:

@@ -225,3 +225,17 @@ def test_cancel_waiting_request():
     assert r2.state == "cancelled"
     sched.run_until_done()
     assert r1.state == "finished" and len(r1.output) == 30
+
+
+def test_inter_token_latency_metrics():
+    """ITL percentiles appear once any request generates >= 2 tokens,
+    and every non-first token contributes exactly one gap sample."""
+    sched, _ = make_sched()
+    r1 = sched.submit([5, 7, 11], max_new_tokens=6)
+    r2 = sched.submit([3, 1], max_new_tokens=4)
+    sched.run_until_done()
+    m = sched.metrics()
+    assert {"itl_p50", "itl_p95", "itl_max"} <= set(m)
+    assert m["itl_p50"] >= 0 and m["itl_max"] >= m["itl_p50"]
+    # gaps = (6-1) + (4-1)
+    assert len(sched._itls) == (len(r1.output) - 1) + (len(r2.output) - 1)
