@@ -105,7 +105,10 @@ class Scheduler:
             "requests_total": 0, "requests_finished": 0,
             "tokens_generated_total": 0, "preemptions_total": 0,
         }
-        self._ttfts: List[float] = []
+        # latency reservoirs: both bounded to the same recent window so
+        # the two adjacent metrics share time-horizon semantics (and a
+        # long-lived server doesn't leak one float per request forever)
+        self._ttfts: Deque[float] = deque(maxlen=4096)
         # inter-token gaps (seconds), bounded reservoir of the most
         # recent gaps across all requests — the latency a decoding
         # request experiences when admissions interleave (the quantity

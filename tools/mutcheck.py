@@ -42,9 +42,47 @@ MUTANTS = [
     # (test_models) — NOT by test_engine, whose compared paths share
     # decode_attend (first mutcheck run found that blind spot).
     ("butterfly_tpu/models/common.py",
-     "out = out + p[..., S:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)",
-     "out = out + 0 * p[..., S:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)",
+     "out = out + p[..., -1:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)",
+     "out = out + 0 * p[..., -1:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)",
      ["tests/test_models.py"], {}),
+    # int8 KV quantizer: wrong scale denominator (codes clip hard)
+    ("butterfly_tpu/models/common.py",
+     "scale = jnp.where(amax > 0, amax / 127.0, 1.0)",
+     "scale = jnp.where(amax > 0, amax / 64.0, 1.0)",
+     ["tests/test_kv_quant.py"], {}),
+    # decode window: attend one not-yet-written window slot
+    ("butterfly_tpu/models/common.py",
+     "s_w = jnp.where(jnp.arange(C)[None, None, None, :] < wlen,",
+     "s_w = jnp.where(jnp.arange(C)[None, None, None, :] <= wlen,",
+     ["tests/test_kv_quant.py", "tests/test_engine.py"], {}),
+    # prefix cache: chain digest forgets the parent (a page would match
+    # regardless of what precedes it)
+    ("butterfly_tpu/cache/prefix.py",
+     "m = hashlib.sha256(h)",
+     "m = hashlib.sha256()",
+     ["tests/test_prefix.py"], {}),
+    # prefix cache: refcount never increments (shared pages freed while
+    # still attached)
+    ("butterfly_tpu/cache/prefix.py",
+     "self._ref[pid] += 1",
+     "self._ref[pid] += 0",
+     ["tests/test_prefix.py"], {}),
+    # prefix cache: register the last sampled (never-written) token's
+    # page as reusable content
+    ("butterfly_tpu/sched/scheduler.py",
+     "return len(req.all_tokens) - 1",
+     "return len(req.all_tokens)",
+     ["tests/test_prefix.py"], {}),
+    # stop sequences: leak the first byte of the stop text
+    ("butterfly_tpu/serve/server.py",
+     "out = self.text[self.released:cut]",
+     "out = self.text[self.released:cut + 1]",
+     ["tests/test_server.py"], {}),
+    # speculative decoding: accept mismatched drafts
+    ("butterfly_tpu/engine/engine.py",
+     "if draft[i] != int(greedy[i]):",
+     "if False and draft[i] != int(greedy[i]):",
+     ["tests/test_speculative.py"], {}),
     # allocator: hand out one page fewer than needed. Must pin the
     # PYTHON backend: with the native lib built, the scheduler uses the
     # C++ twin and a Python-side mutation is invisible (first mutcheck
