@@ -77,6 +77,11 @@ class InferenceEngine:
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
+        # (B, max_seq) -> reusable KV buffers from the previous call;
+        # bounded (FIFO) so varying shapes can't pin unbounded HBM
+        from collections import OrderedDict
+        self._cache_pool: "OrderedDict" = OrderedDict()
+        self._cache_pool_cap = 2
         # Inference reads every weight every step: keep params in the
         # compute dtype so the decode loop streams half the HBM bytes
         # (the in-scan cast then no-ops and XLA elides it).
@@ -195,10 +200,17 @@ class InferenceEngine:
         # the window; the tail steps write (frozen) tokens past `total`
         max_seq = max(self.runtime.max_seq_len,
                       total + self._decode_window - 1)
-        cache = self.new_cache(B, max_seq)
-        if self.mesh is not None:
-            from butterfly_tpu.parallel.partition import shard_cache
-            cache = shard_cache(cache, self.cfg, self.mesh)
+        # Reuse the previous call's (donated-through) cache buffers when
+        # the shape matches: a fresh pool pays allocation + memset of
+        # ~GBs per call, and stale K/V is harmless — prefill overwrites
+        # positions 0..T-1 and the causal mask never reaches past each
+        # row's written length.
+        cache = self._cache_pool.pop((B, max_seq), None)
+        if cache is None:
+            cache = self.new_cache(B, max_seq)
+            if self.mesh is not None:
+                from butterfly_tpu.parallel.partition import shard_cache
+                cache = shard_cache(cache, self.cfg, self.mesh)
         key, first_key, loop_key = jax.random.split(jax.random.PRNGKey(seed), 3)
 
         with self._mesh_ctx():
@@ -207,9 +219,9 @@ class InferenceEngine:
             first = sample(logits, first_key, sp)
 
             if fused:
-                out, lens, _ = self._generate_fused(self.params, first,
-                                                    cache, loop_key, sp,
-                                                    sp.max_new_tokens)
+                out, lens, cache = self._generate_fused(self.params, first,
+                                                        cache, loop_key, sp,
+                                                        sp.max_new_tokens)
                 out, lens = np.asarray(out), np.asarray(lens)
             else:
                 toks = [np.asarray(first)]
@@ -222,6 +234,9 @@ class InferenceEngine:
                 out = np.stack(toks, axis=1)
                 lens = _stop_lengths(out, sp.stop_token)
                 out = _mask_after_stop(out, lens, sp.stop_token)
+        self._cache_pool[(B, max_seq)] = cache
+        while len(self._cache_pool) > self._cache_pool_cap:
+            self._cache_pool.popitem(last=False)  # FIFO-evict (frees HBM)
         return GenerateResult(tokens=out[:n_real], lengths=lens[:n_real],
                               prompt_lengths=np.asarray(true_lens)[:n_real])
 
@@ -386,9 +401,10 @@ def _generate_fused(fwd, params, first, cache, key,
         body, (first, cache, key, done0), None, length=max_new - 1)
     out = jnp.concatenate([first[:, None], toks.T], axis=1)  # [B, max_new]
     lens = _stop_lengths_jnp(out, sp.stop_token)
-    # The final cache is returned (and ignored by callers) purely so the
-    # donated input cache has an output to alias — otherwise XLA keeps a
-    # second full KV pool live for the whole scan.
+    # The final cache is returned so the donated input cache has an
+    # output to alias (otherwise XLA keeps a second full pool live for
+    # the whole scan) AND so generate() can recycle the buffers for the
+    # next call instead of allocating fresh pools.
     return out, lens, cache
 
 

@@ -281,12 +281,15 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
     """One attention sublayer with contiguous-cache update.
 
     x: [B,T,D]; ck/cv: [B,S,Kv,H]; positions: [B,T]; mask: [B,T,S].
-    `fresh` (static) asserts the cache holds nothing before this call
-    (positions start at 0) — required to take the flash path, which
-    attends only over the freshly projected K/V. Warm multi-token calls
-    (chunked prefill / continuation) fall back to dense cache attention
-    even when cfg.attn_impl == "flash", so prior context is never
-    silently dropped.
+    `fresh` (static) asserts positions start at 0 and nothing LIVE
+    precedes this call's tokens — required to take the flash path,
+    which attends only over the freshly projected K/V. The cache
+    buffers may still hold stale bytes from a recycled pool
+    (engine cache reuse): correctness must come from position masking
+    and overwrite-before-attend, never from assuming zeroed buffers.
+    Warm multi-token calls (chunked prefill / continuation) fall back
+    to dense cache attention even when cfg.attn_impl == "flash", so
+    prior context is never silently dropped.
 
     int8 cache: pass codes ck/cv [B,Kv,S,H] + scales k_s/v_s [B,Kv,S];
     the return gains the updated scales — (out, ck, cv, k_s, v_s)
@@ -776,7 +779,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     """Run the model over `tokens` [B,T], reading/updating `cache`.
 
     positions defaults to cache.length[:,None] + arange(T) (append).
-    `fresh` (static) = the cache is empty and positions start at 0; only
+    `fresh` (static) = no LIVE entries precede this call's tokens and
+    positions start at 0 (recycled buffers may hold stale bytes —
+    masking, not zeroing, is the correctness mechanism); only
     then may the flash prefill kernel be used (see attention_block).
     Single-token warm calls take the decode fast path (_decode_forward:
     deferred one-shot cache write). Returns (logits [B,T,V] float32,
