@@ -4,16 +4,33 @@ Realizes BASELINE.json configs[4] ("continuous batching + paged KV cache");
 the reference has no implementation (SURVEY.md §0). Design (vLLM-style
 semantics, TPU-native mechanics):
 
-* One global page pool per layer stack: k/v_pages [L, P, page, Kv, H] in
+* One global page pool per layer stack: k/v_pages [L, P, Kv, page, H] in
   HBM. Sequences own pages through a block table [slots, max_pages] of
   page ids; page P-1 is reserved as the null page (block tables are
   initialized to it, so gathers from unallocated slots read zeros and the
   causal mask hides them).
+* The dim order puts (page, H) minor: TPU tiles pad the two minor dims
+  ((16,128) bf16, (32,128) int8), so a Kv-minor layout would inflate
+  physical HBM 2-4x for GQA models (Kv=8 pads to the sublane tile); with
+  page_size >= the sublane tile there is no padding at all, and each
+  (kv, page) read is one contiguous [page, H] tile run.
+* int8 mode (RuntimeConfig.kv_quant="int8"): k/v_pages hold int8 codes
+  and k/v_scale_pages [L, P, Kv*page] hold one f32 scale per stored
+  vector (absmax over head_dim / 127 — models.common.quantize_kv). The
+  scale dim is FLATTENED kv-major: (a) the page-granular decode kernel
+  streams it as one lane-aligned [Kv*page] row per page (a 2-D [Kv,page]
+  block would need a sublane->lane relayout in-kernel), and (b) a
+  `tensor`-axis shard of the Kv dim is a contiguous chunk of the flat
+  dim (chunk = (Kv/tp)*page), so the same PartitionSpec machinery
+  shards codes and scales consistently. Decode streams half the cache
+  bytes from HBM; dequantization fuses into the attention dots (K scale
+  applied to scores output-side, V scale folded into the probs), so no
+  bf16 copy of the pool ever materializes.
 * Token writes are scatters (`.at[...].set`) at (page_table[slot, t//page],
   t%page) — XLA Scatter keeps the pool HBM-resident, the paged analogue of
   the contiguous cache's DynamicUpdateSlice.
 * Attention reads gather each slot's pages back into a contiguous
-  [B, S_max, Kv, H] view per layer (XLA Gather). This reference path reads
+  [B, S_max, ...] view per layer (XLA Gather). This reference path reads
   the same bytes a contiguous cache would; the Pallas paged-attention
   kernel (ops/) replaces gather+attend for decode so only *used* pages are
   touched.
@@ -32,14 +49,16 @@ from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
 
 
 class PagedKVCache(NamedTuple):
-    k_pages: jax.Array     # [L, P, page, Kv, H]
-    v_pages: jax.Array     # [L, P, page, Kv, H]
+    k_pages: jax.Array     # [L, P, Kv, page, H] (int8 codes when quantized)
+    v_pages: jax.Array     # [L, P, Kv, page, H]
     page_table: jax.Array  # [slots, max_pages] int32, null = P-1
     lengths: jax.Array     # [slots] int32 tokens written per slot
+    k_scale_pages: Optional[jax.Array] = None  # [L, P, Kv*page] f32 iff int8
+    v_scale_pages: Optional[jax.Array] = None
 
     @property
     def page_size(self) -> int:
-        return self.k_pages.shape[2]
+        return self.k_pages.shape[3]
 
     @property
     def num_pages(self) -> int:
@@ -57,39 +76,63 @@ class PagedKVCache(NamedTuple):
     def num_slots(self) -> int:
         return self.page_table.shape[0]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale_pages is not None
+
 
 def init_paged_cache(cfg: ModelConfig, runtime: RuntimeConfig,
                      dtype: Optional[jnp.dtype] = None) -> PagedKVCache:
-    """Pool sized from the runtime config (+1 reserved null page)."""
+    """Pool sized from the runtime config (+1 reserved null page).
+
+    runtime.kv_quant="int8" allocates int8 code pools + f32 scale pools
+    (the serving-path twin of models.common.init_cache(quant="int8"))."""
     dtype = dtype or jnp.dtype(cfg.dtype)
     page = runtime.page_size
     max_pages = -(-runtime.max_seq_len // page)
     P = runtime.num_pages or runtime.max_batch_size * max_pages
     P += 1  # null page
-    shape = (cfg.num_layers, P, page, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, P, cfg.num_kv_heads, page, cfg.head_dim)
+    table = jnp.full((runtime.max_batch_size, max_pages), P - 1, jnp.int32)
+    lengths = jnp.zeros((runtime.max_batch_size,), jnp.int32)
+    if runtime.kv_quant == "int8":
+        sshape = (cfg.num_layers, P, cfg.num_kv_heads * page)
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, jnp.int8),
+            v_pages=jnp.zeros(shape, jnp.int8),
+            page_table=table, lengths=lengths,
+            k_scale_pages=jnp.zeros(sshape, jnp.float32),
+            v_scale_pages=jnp.zeros(sshape, jnp.float32),
+        )
+    if runtime.kv_quant != "none":
+        raise ValueError(f"unknown kv quant {runtime.kv_quant!r}")
     return PagedKVCache(
         k_pages=jnp.zeros(shape, dtype),
         v_pages=jnp.zeros(shape, dtype),
-        page_table=jnp.full((runtime.max_batch_size, max_pages), P - 1,
-                            jnp.int32),
-        lengths=jnp.zeros((runtime.max_batch_size,), jnp.int32),
+        page_table=table, lengths=lengths,
     )
 
 
 def write_paged_layer(k_pages: jax.Array, v_pages: jax.Array,
                       page_table: jax.Array, k: jax.Array, v: jax.Array,
                       start: jax.Array,
-                      active: Optional[jax.Array] = None
-                      ) -> Tuple[jax.Array, jax.Array]:
+                      active: Optional[jax.Array] = None,
+                      k_scale_pages: Optional[jax.Array] = None,
+                      v_scale_pages: Optional[jax.Array] = None):
     """Scatter new tokens into one layer's page pool.
 
-    k_pages/v_pages: [P, page, Kv, H]; k/v: [B, T, Kv, H] (T new tokens per
+    k_pages/v_pages: [P, Kv, page, H]; k/v: [B, T, Kv, H] (T new tokens per
     slot); start: [B] first absolute position of each slot's new tokens.
     Inactive slots' writes are redirected to the null page. Positions past
     a slot's allocated pages must not occur for active slots (the host
     allocator guarantees capacity before scheduling the step).
+
+    Quantized pools (int8 codes + scale pools [P, Kv*page]): k/v arrive
+    as floats and are quantized per-vector on the way in. Returns
+    (k_pages, v_pages, k_scale_pages, v_scale_pages) — scales None when
+    the pool is float.
     """
-    Pp, page, Kv, H = k_pages.shape
+    Pp, Kv, page, H = k_pages.shape
     B, T = k.shape[0], k.shape[1]
     pos = start[:, None] + jnp.arange(T)[None, :]          # [B,T] absolute
     page_idx = jnp.take_along_axis(page_table, pos // page, axis=1)  # [B,T]
@@ -103,19 +146,48 @@ def write_paged_layer(k_pages: jax.Array, v_pages: jax.Array,
     offset = pos % page                                     # [B,T]
     flat_pages = page_idx.reshape(-1)
     flat_off = offset.reshape(-1)
+    if k_scale_pages is not None:
+        from butterfly_tpu.models.common import quantize_kv
+        kq, ks = quantize_kv(k)   # codes [B,T,Kv,H], scales [B,T,Kv]
+        vq, vs = quantize_kv(v)
+        k_pages = k_pages.at[flat_pages, :, flat_off].set(
+            kq.reshape(B * T, Kv, H))
+        v_pages = v_pages.at[flat_pages, :, flat_off].set(
+            vq.reshape(B * T, Kv, H))
+        # flat scale dim is kv-major: col = kv*page + offset
+        cols = jnp.arange(Kv)[None, :] * page + flat_off[:, None]  # [BT,Kv]
+        k_scale_pages = k_scale_pages.at[flat_pages[:, None], cols].set(
+            ks.reshape(B * T, Kv))
+        v_scale_pages = v_scale_pages.at[flat_pages[:, None], cols].set(
+            vs.reshape(B * T, Kv))
+        return k_pages, v_pages, k_scale_pages, v_scale_pages
     kf = k.reshape(B * T, Kv, H).astype(k_pages.dtype)
     vf = v.reshape(B * T, Kv, H).astype(v_pages.dtype)
-    k_pages = k_pages.at[flat_pages, flat_off].set(kf)
-    v_pages = v_pages.at[flat_pages, flat_off].set(vf)
-    return k_pages, v_pages
+    k_pages = k_pages.at[flat_pages, :, flat_off].set(kf)
+    v_pages = v_pages.at[flat_pages, :, flat_off].set(vf)
+    return k_pages, v_pages, None, None
 
 
 def gather_paged_layer(pages: jax.Array, page_table: jax.Array) -> jax.Array:
     """One layer's pages -> contiguous [B, S_max, Kv, H] view (XLA Gather)."""
-    Pp, page, Kv, H = pages.shape
+    Pp, Kv, page, H = pages.shape
     B, max_pages = page_table.shape
-    out = pages[page_table]                 # [B, max_pages, page, Kv, H]
+    out = pages[page_table]                 # [B, max_pages, Kv, page, H]
+    out = out.transpose(0, 1, 3, 2, 4)      # [B, max_pages, page, Kv, H]
     return out.reshape(B, max_pages * page, Kv, H)
+
+
+def gather_paged_layer_q(pages: jax.Array, scale_pages: jax.Array,
+                         page_table: jax.Array):
+    """Quantized gather: codes [B, Kv, S, H] + scales [B, Kv, S] — the
+    kv-major order models.common.attend expects for int8 caches."""
+    Pp, Kv, page, H = pages.shape
+    B, max_pages = page_table.shape
+    codes = pages[page_table]               # [B, mp, Kv, page, H]
+    codes = codes.transpose(0, 2, 1, 3, 4).reshape(B, Kv, max_pages * page, H)
+    sc = scale_pages[page_table]            # [B, mp, Kv*page]
+    sc = sc.reshape(B, max_pages, Kv, page).transpose(0, 2, 1, 3)
+    return codes, sc.reshape(B, Kv, max_pages * page)
 
 
 # ---------------------------------------------------------------------------
@@ -124,43 +196,56 @@ def gather_paged_layer(pages: jax.Array, page_table: jax.Array) -> jax.Array:
 
 def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
                      positions, mask, cos, sin, active, use_kernel: bool,
-                     fresh: bool):
+                     fresh: bool, ksp=None, vsp=None):
     """One transformer layer against one layer's page pool slice.
 
     Shared by paged_forward's full-stack scan and the stage-local scan of
     the pipeline serving path (parallel/pipeline.py) so the two cannot
-    drift. x: [B,T,D]; kp/vp: [P,page,Kv,H]; returns (x, kp, vp).
+    drift. x: [B,T,D]; kp/vp: [P,Kv,page,H]; ksp/vsp: [P,Kv*page] scale
+    slices iff the pool is int8. Returns (x, kp, vp[, ksp, vsp]).
     """
     from butterfly_tpu.models.common import (
         _cast_float, attend, attn_output, ffn_block, pre_norm, qkv_proj)
 
     T = x.shape[1]
+    quant = ksp is not None
     compute_dtype = jnp.dtype(cfg.dtype)
     lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
     start = positions[:, 0]
 
     h = pre_norm(x, lp["ln1"], cfg)
     q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-    kp, vp = write_paged_layer(kp, vp, page_table, k, v, start, active)
+    kp, vp, ksp, vsp = write_paged_layer(kp, vp, page_table, k, v, start,
+                                         active, ksp, vsp)
     out = None
     if use_kernel and T == 1:
         from butterfly_tpu.ops.paged_attention import paged_attention_sharded
         # lengths INCLUDING the token just written (inactive: 0 -> no
         # pages visited, output discarded)
         lens = jnp.where(active, positions[:, 0] + 1, 0)
-        out = paged_attention_sharded(q[:, 0], kp, vp, page_table, lens)
+        out = paged_attention_sharded(q[:, 0], kp, vp, page_table, lens,
+                                      ksp, vsp)
         out = out[:, None] if out is not None else None
     elif cfg.attn_impl == "flash" and T > 1 and fresh:
         from butterfly_tpu.ops.flash_attention import flash_attention_sharded
+        # fresh prefill attends over the just-projected bf16 K/V, so the
+        # kernel path is identical for int8 pools
         out = flash_attention_sharded(q, k, v, causal=True)
     if out is None:
         # no mesh axis can shard the kernel operands (or kernels off):
         # dense gather attention, which GSPMD partitions itself.
-        ck = gather_paged_layer(kp, page_table)
-        cv = gather_paged_layer(vp, page_table)
-        out = attend(q, ck, cv, mask, cfg)
+        if quant:
+            ck, k_s = gather_paged_layer_q(kp, ksp, page_table)
+            cv, v_s = gather_paged_layer_q(vp, vsp, page_table)
+            out = attend(q, ck, cv, mask, cfg, k_s, v_s)
+        else:
+            ck = gather_paged_layer(kp, page_table)
+            cv = gather_paged_layer(vp, page_table)
+            out = attend(q, ck, cv, mask, cfg)
     x = x + attn_output(out, lp["attn"], cfg)
     x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+    if quant:
+        return x, kp, vp, ksp, vsp
     return x, kp, vp
 
 
@@ -186,6 +271,7 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     from butterfly_tpu.models.common import embed_tokens, final_logits, make_mask
 
     B, T = tokens.shape
+    quant = cache.quantized
     if positions is None:
         positions = cache.lengths[:, None] + jnp.arange(T)[None, :]
     if active is None:
@@ -196,15 +282,20 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     mask = mask & active[:, None, None]
 
     def body(x, scanned):
-        lp, kp, vp = scanned
-        x, kp, vp = paged_layer_body(
+        lp, kp, vp, *scales = scanned
+        out = paged_layer_body(
             x, lp, kp, vp, cfg=cfg, page_table=cache.page_table,
             positions=positions, mask=mask, cos=cos, sin=sin, active=active,
-            use_kernel=use_kernel, fresh=fresh)
-        return x, (kp, vp)
+            use_kernel=use_kernel, fresh=fresh,
+            ksp=scales[0] if scales else None,
+            vsp=scales[1] if scales else None)
+        return out[0], tuple(out[1:])
 
-    x, (new_k, new_v) = lax.scan(
-        body, x, (params["layers"], cache.k_pages, cache.v_pages))
+    xs = (params["layers"], cache.k_pages, cache.v_pages)
+    if quant:
+        xs = xs + (cache.k_scale_pages, cache.v_scale_pages)
+    x, new_pools = lax.scan(body, x, xs)
     logits = final_logits(params, cfg, x)
     new_len = jnp.where(active, cache.lengths + T, cache.lengths)
-    return logits, PagedKVCache(new_k, new_v, cache.page_table, new_len)
+    return logits, PagedKVCache(new_pools[0], new_pools[1],
+                                cache.page_table, new_len, *new_pools[2:])

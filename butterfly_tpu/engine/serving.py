@@ -116,10 +116,10 @@ class ServingEngine:
         # and warm (chunk continuation — attends through the cache, dense).
         self._prefill = jax.jit(
             partial(_prefill_slot, prefill_cfg, True, fwd),
-            donate_argnums=(2, 3))
+            donate_argnums=(2,))
         self._prefill_warm = jax.jit(
             partial(_prefill_slot, self.cfg, False, fwd),
-            donate_argnums=(2, 3))
+            donate_argnums=(2,))
         self._decode = jax.jit(
             partial(_decode_all, self.cfg, fwd, use_kernel=use_kernels),
             static_argnums=(5, 6), donate_argnums=(2,))
@@ -178,13 +178,19 @@ class ServingEngine:
         prog = self._prefill if start == 0 else self._prefill_warm
         self._sync_table()
         with self._mesh_ctx():
-            logits, k_pages, v_pages = prog(
-                self.params, jnp.asarray(buf), self.cache.k_pages,
-                self.cache.v_pages, self.cache.page_table[slot][None],
+            # pools are donated (scatters land in place); the slot's table
+            # row rides separately so the donation set has no unaliasable
+            # leaves (the row has no matching output)
+            pools = (self.cache.k_pages, self.cache.v_pages,
+                     self.cache.k_scale_pages, self.cache.v_scale_pages)
+            logits, pools = prog(
+                self.params, jnp.asarray(buf), pools,
+                self.cache.page_table[slot][None],
                 jnp.asarray([len(tokens)], jnp.int32),
                 jnp.asarray([start], jnp.int32))
             self.cache = self.cache._replace(
-                k_pages=k_pages, v_pages=v_pages,
+                k_pages=pools[0], v_pages=pools[1],
+                k_scale_pages=pools[2], v_scale_pages=pools[3],
                 lengths=self.cache.lengths.at[slot].set(start + len(tokens)))
         return logits[0]
 
@@ -212,21 +218,24 @@ class ServingEngine:
 
 
 def _prefill_slot(cfg: ModelConfig, fresh: bool, fwd, params, tokens,
-                  k_pages, v_pages, table_row, true_len, start):
+                  pools, table_row, true_len, start):
     """[1,T] prompt chunk against the slot's table row; pool-wide scatter.
 
-    `start` [1] is the chunk's first absolute position; `fresh` (static)
-    means start==0 and the slot's pages are empty (flash-path eligible).
-    `fwd` is paged_forward or its stage-pipelined twin.
+    `pools` is the (k, v[, k_scale, v_scale]) pool tuple (donated —
+    scatters land in place), paired with ONE slot's table row; `start`
+    [1] is the chunk's first absolute position; `fresh` (static) means
+    start==0 and the slot's pages are empty (flash-path eligible). `fwd`
+    is paged_forward or its stage-pipelined twin.
     """
-    cache1 = PagedKVCache(k_pages, v_pages, table_row,
-                          jnp.zeros((1,), jnp.int32))
+    cache1 = PagedKVCache(pools[0], pools[1], table_row,
+                          jnp.zeros((1,), jnp.int32), pools[2], pools[3])
     B, T = tokens.shape
     positions = start[:, None] + jnp.broadcast_to(jnp.arange(T)[None, :],
                                                   (B, T))
     logits, cache1 = fwd(params, cfg, tokens, cache1, positions, fresh=fresh)
     last = jnp.take_along_axis(logits, (true_len - 1)[:, None, None], axis=1)
-    return last[:, 0, :], cache1.k_pages, cache1.v_pages
+    return last[:, 0, :], (cache1.k_pages, cache1.v_pages,
+                           cache1.k_scale_pages, cache1.v_scale_pages)
 
 
 def _decode_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,

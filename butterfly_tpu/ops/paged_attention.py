@@ -19,6 +19,11 @@ slot's block table and touches ONLY its live pages:
 * Decode has one query token per slot, so the MXU sees [Nq, H] x
   [H, page] per step — small, but the kernel is bandwidth-bound and reads
   ceil(len/page) pages instead of S_max.
+* int8 pools: codes stream as-is (half the bytes — the entire point);
+  per-vector scales ride along as one lane-aligned [Kv*page] row per
+  page and fuse into the dots exactly like models.common.attend does for
+  the contiguous int8 cache: K scales multiply the score columns, V
+  scales fold into the probs. No dequantized copy is ever materialized.
 
 Off-TPU the wrapper runs the kernel in interpreter mode (CPU tests cover
 the exact kernel path).
@@ -35,8 +40,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, page: int, kv_heads: int):
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                  page: int, kv_heads: int, quant: bool):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     slot = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -51,21 +60,30 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * page < length)
     def _compute():
         # Mosaic-friendly GQA: ONE 2D matmul against the flattened
-        # [page*Kv, H] block, with cross-group scores masked off. The
-        # Kv-fold column redundancy is tiny (page*Kv cols) and keeps
+        # [Kv*page, H] block, with cross-group scores masked off. The
+        # Kv-fold column redundancy is tiny (Kv*page cols) and keeps
         # everything on the plain MXU path (batched matmuls with
-        # mismatched batch dims don't lower).
+        # mismatched batch dims don't lower). The pool's [Kv, page, H]
+        # block collapses its two leading dims for free (address
+        # arithmetic only), so column c = kv*page + p — the same
+        # kv-major order the flat scale rows use.
         q = q_ref[0].astype(jnp.float32)               # [Nq, H]
-        kf = k_ref[0].astype(jnp.float32).reshape(page * kv_heads, -1)
-        vf = v_ref[0].astype(jnp.float32).reshape(page * kv_heads, -1)
+        kf = k_ref[0].astype(jnp.float32).reshape(kv_heads * page, -1)
+        vf = v_ref[0].astype(jnp.float32).reshape(kv_heads * page, -1)
         Nq, H = q.shape
         G = Nq // kv_heads
         scale = jax.lax.rsqrt(jnp.asarray(H, jnp.float32))
 
-        s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32) * scale
-        cols = jax.lax.broadcasted_iota(jnp.int32, (Nq, page * kv_heads), 1)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (Nq, page * kv_heads), 0)
-        col_kv, col_p = cols % kv_heads, cols // kv_heads
+        s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32)
+        if quant:
+            # per-column K scale (scores = q . (codes*scale) done
+            # output-side — same associativity as attend()). [1, C]
+            # broadcasts over the Nq sublanes.
+            s = s * ks_ref[0]
+        s = s * scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, (Nq, kv_heads * page), 1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Nq, kv_heads * page), 0)
+        col_kv, col_p = cols // page, cols % page
         group_ok = col_kv == rows // G                 # head n <-> kv n//G
         pos = j * page + col_p
         mask = group_ok & (pos < length)
@@ -73,9 +91,11 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Nq, page*Kv]
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Nq, Kv*page]
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if quant:
+            p = p * vs_ref[0]                          # V scale into probs
         acc_ref[:] = acc_ref[:] * corr + jnp.dot(
             p, vf, preferred_element_type=jnp.float32)
         m_ref[:] = m_new
@@ -88,14 +108,18 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
                             v_pages: jax.Array, page_table: jax.Array,
-                            lengths: jax.Array) -> jax.Array:
+                            lengths: jax.Array,
+                            k_scale_pages: jax.Array = None,
+                            v_scale_pages: jax.Array = None) -> jax.Array:
     """Mesh-aware paged attention for meshed serving (SURVEY.md §7 stage 6).
 
     shard_map over the axes the paged partitioner uses
     (parallel/partition.py paged_cache_specs): slots over `data`, q/kv
     heads over `tensor`; the page-id dim stays replicated (any slot may
-    reference any page). Each shard walks its own slots' block tables with
-    the unmodified kernel — purely local, no collectives.
+    reference any page). A `tensor` shard of the flat [Kv*page] scale dim
+    is the same contiguous kv-group chunk as the code pool's Kv shard, so
+    one spec set covers both. Each shard walks its own slots' block
+    tables with the unmodified kernel — purely local, no collectives.
 
     Returns None when a live multi-device Auto mesh is present but no
     axis can shard the operands — the caller must use the gather path
@@ -113,44 +137,69 @@ def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
     if d is None and t is None:
         if live_auto_mesh():
             return None
-        return paged_attention(q, k_pages, v_pages, page_table, lengths)
-    kv_spec = P(None, None, t, None)
+        return paged_attention(q, k_pages, v_pages, page_table, lengths,
+                               k_scale_pages, v_scale_pages)
+    kv_spec = P(None, t, None, None)
+    in_specs = [P(d, t, None), kv_spec, kv_spec, P(d, None), P(d)]
+    args = [q, k_pages, v_pages, page_table, lengths]
+    if k_scale_pages is not None:
+        in_specs += [P(None, t), P(None, t)]
+        args += [k_scale_pages, v_scale_pages]
     fn = jax.shard_map(
         paged_attention,
-        in_specs=(P(d, t, None), kv_spec, kv_spec, P(d, None), P(d)),
+        in_specs=tuple(in_specs),
         out_specs=P(d, t, None),
         axis_names={a for a in (d, t) if a is not None}, check_vma=False)
-    return fn(q, k_pages, v_pages, page_table, lengths)
+    return fn(*args)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, lengths: jax.Array,
+                    k_scale_pages: jax.Array = None,
+                    v_scale_pages: jax.Array = None,
                     interpret: bool | None = None) -> jax.Array:
     """Single-token attention over each slot's paged KV.
 
     q: [slots, Nq, H] (the one decode token per slot, post-rope);
-    k_pages/v_pages: [P, page, Kv, H] (one layer's pool);
+    k_pages/v_pages: [P, Kv, page, H] (one layer's pool);
     page_table: [slots, max_pages] int32; lengths: [slots] int32 —
-    number of cache tokens INCLUDING the just-written current token.
-    Returns [slots, Nq, H].
+    number of cache tokens INCLUDING the just-written current token;
+    k/v_scale_pages: [P, Kv*page] f32 per-vector scales iff the pool
+    holds int8 codes. Returns [slots, Nq, H].
     """
     S, Nq, H = q.shape
-    Pp, page, Kv, H2 = k_pages.shape
+    Pp, Kv, page, H2 = k_pages.shape
     max_pages = page_table.shape[1]
+    quant = k_scale_pages is not None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    in_specs = [
+        pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
+        pl.BlockSpec((1, Kv, page, H),
+                     lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
+        pl.BlockSpec((1, Kv, page, H),
+                     lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
+    ]
+    args = [q, k_pages, v_pages]
+    if quant:
+        # [P, C] -> [P, 1, C] (free bitcast): Mosaic requires the block's
+        # minor-two dims to tile (8, 128) or equal the array's — a (1, C)
+        # block of a [P, C] array does neither, but (1, 1, C) of
+        # [P, 1, C] matches the array exactly.
+        in_specs += [
+            pl.BlockSpec((1, 1, Kv * page),
+                         lambda s, j, t, ln: (t[s, j], 0, 0)),
+            pl.BlockSpec((1, 1, Kv * page),
+                         lambda s, j, t, ln: (t[s, j], 0, 0)),
+        ]
+        args += [k_scale_pages.reshape(Pp, 1, Kv * page),
+                 v_scale_pages.reshape(Pp, 1, Kv * page)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
-            pl.BlockSpec((1, page, Kv, H),
-                         lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, Kv, H),
-                         lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Nq, 1), jnp.float32),
@@ -158,7 +207,8 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
             pltpu.VMEM((Nq, H), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_kernel, page=page, kv_heads=Kv)
+    kernel = functools.partial(_paged_kernel, page=page, kv_heads=Kv,
+                               quant=quant)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -166,4 +216,4 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(page_table, lengths, q, k_pages, v_pages)
+    )(page_table, lengths, *args)

@@ -148,10 +148,11 @@ def _div_any(mesh: Mesh, axis: str) -> Optional[str]:
     return axis if mesh.shape[axis] > 1 else None
 
 
-def paged_cache_specs(cfg: ModelConfig, mesh: Mesh, num_slots: int):
+def paged_cache_specs(cfg: ModelConfig, mesh: Mesh, num_slots: int,
+                      quant: bool = False):
     """Specs for the PagedKVCache pytree (serving under a mesh).
 
-    Pool k/v_pages [L,P,page,Kv,H]: layers over `stage` (each pipeline
+    Pool k/v_pages [L,P,Kv,page,H]: layers over `stage` (each pipeline
     stage owns only its local layers' pages, mirroring param_specs),
     kv-heads over `tensor` (matching the Megatron column-parallel wk/wv
     so paged writes stay local to the TP shard). The page-id dim P stays
@@ -159,18 +160,25 @@ def paged_cache_specs(cfg: ModelConfig, mesh: Mesh, num_slots: int):
     may reference any page, so sharding P would turn every gather into a
     cross-`data` collective. Slot-indexed leaves (page_table [S,maxp],
     lengths [S]) shard slots over `data` when divisible — the decode step
-    then runs data-parallel over slots.
+    then runs data-parallel over slots. int8 pools add scale leaves
+    [L,P,Kv*page] whose flat dim shards over `tensor` iff Kv does (a
+    tensor chunk of the kv-major flat dim is exactly one kv-group's
+    scales — see cache/paged.py layout notes).
     """
     from butterfly_tpu.cache.paged import PagedKVCache
     dslots = _div(num_slots, mesh, "data")
-    kv = P(_div(cfg.num_layers, mesh, "stage"), None, None,
-           _div(cfg.num_kv_heads, mesh, "tensor"), None)
+    lspec = _div(cfg.num_layers, mesh, "stage")
+    tspec = _div(cfg.num_kv_heads, mesh, "tensor")
+    kv = P(lspec, None, tspec, None, None)
+    sc = P(lspec, None, tspec) if quant else None
     return PagedKVCache(k_pages=kv, v_pages=kv,
-                        page_table=P(dslots, None), lengths=P(dslots))
+                        page_table=P(dslots, None), lengths=P(dslots),
+                        k_scale_pages=sc, v_scale_pages=sc)
 
 
 def shard_paged_cache(cache, cfg: ModelConfig, mesh: Mesh):
-    specs = paged_cache_specs(cfg, mesh, cache.num_slots)
+    specs = paged_cache_specs(cfg, mesh, cache.num_slots,
+                              quant=cache.quantized)
     return jax.device_put(cache, to_shardings(specs, mesh))
 
 

@@ -189,14 +189,19 @@ def paged_pipeline_forward(params: Params, cfg: ModelConfig,
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq) & active[:, None, None]
 
+    quant = cache.quantized
+    stage_ops = (cache.k_pages, cache.v_pages)
+    if quant:  # scale pools stage-shard their L dim like the code pools
+        stage_ops += (cache.k_scale_pages, cache.v_scale_pages)
     body = partial(_paged_pipeline_body, cfg=cfg, S=S, M=M,
-                   use_kernel=use_kernel, fresh=fresh)
-    y, (new_k, new_v) = _run_gpipe(
-        body, mesh, params["layers"], (cache.k_pages, cache.v_pages),
+                   use_kernel=use_kernel, fresh=fresh, quant=quant)
+    y, new_pools = _run_gpipe(
+        body, mesh, params["layers"], stage_ops,
         (x, cache.page_table, positions, mask, cos, sin, active), S, M, x)
     logits = final_logits(params, cfg, y)
     new_len = jnp.where(active, cache.lengths + T, cache.lengths)
-    return logits, PagedKVCache(new_k, new_v, cache.page_table, new_len)
+    return logits, PagedKVCache(new_pools[0], new_pools[1],
+                                cache.page_table, new_len, *new_pools[2:])
 
 
 def _gpipe_schedule(S: int, M: int, xs, step_fn, carry0):
@@ -232,17 +237,23 @@ def _gpipe_schedule(S: int, M: int, xs, step_fn, carry0):
     return outs, carry
 
 
-def _paged_pipeline_body(layers, k_pages, v_pages, x, page_table, positions,
-                         mask, cos, sin, active, *, cfg: ModelConfig,
-                         S: int, M: int, use_kernel: bool, fresh: bool):
+def _paged_pipeline_body(layers, k_pages, v_pages, *ops, cfg: ModelConfig,
+                         S: int, M: int, use_kernel: bool, fresh: bool,
+                         quant: bool = False):
     """Per-stage GPipe body over the paged pool (manual over stage).
 
-    layers/k_pages/v_pages are the local [L/S, ...] stage slice; x, the
-    block table, and the per-token aux arrays are full-slot-batch and
-    replicated over stage.
+    layers/k_pages/v_pages (and, for int8 pools, the two scale pools that
+    lead `ops`) are the local [L/S, ...] stage slice; x, the block table,
+    and the per-token aux arrays are full-slot-batch and replicated over
+    stage.
     """
     from butterfly_tpu.cache.paged import paged_layer_body
 
+    if quant:
+        ksp0, vsp0, x, page_table, positions, mask, cos, sin, active = ops
+    else:
+        x, page_table, positions, mask, cos, sin, active = ops
+        ksp0 = vsp0 = None
     B = x.shape[0]
     mb = B // M
 
@@ -255,25 +266,32 @@ def _paged_pipeline_body(layers, k_pages, v_pages, x, page_table, positions,
     act_mb = active.reshape(M, mb)
 
     def step(carry, mc, valid, inp):
-        kp, vp = carry
+        kp, vp, ksp, vsp = carry
         # bubble ticks redirect their pool writes to the null page via the
         # active mask (the paged analogue of the contiguous path's
         # where(valid) write-back)
         act = act_mb[mc] & valid
 
         def layer(x, scanned):
-            lp, kpl, vpl = scanned
-            x, kpl, vpl = paged_layer_body(
+            lp, kpl, vpl, *scl = scanned
+            out = paged_layer_body(
                 x, lp, kpl, vpl, cfg=cfg, page_table=tbl_mb[mc],
                 positions=pos_mb[mc], mask=mask_mb[mc], cos=cos_mb[mc],
                 sin=sin_mb[mc], active=act, use_kernel=use_kernel,
-                fresh=fresh)
-            return x, (kpl, vpl)
+                fresh=fresh, ksp=scl[0] if scl else None,
+                vsp=scl[1] if scl else None)
+            return out[0], tuple(out[1:])
 
-        y, (kp, vp) = lax.scan(layer, inp, (layers, kp, vp))
-        return y, (kp, vp)
+        scan_xs = (layers, kp, vp) + ((ksp, vsp) if quant else ())
+        y, new = lax.scan(layer, inp, scan_xs)
+        if quant:
+            return y, new
+        return y, (*new, None, None)
 
-    outs, (kp, vp) = _gpipe_schedule(S, M, xs, step, (k_pages, v_pages))
+    outs, (kp, vp, ksp, vsp) = _gpipe_schedule(
+        S, M, xs, step, (k_pages, v_pages, ksp0, vsp0))
+    if quant:
+        return outs, kp, vp, ksp, vsp
     return outs, kp, vp
 
 

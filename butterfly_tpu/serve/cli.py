@@ -44,13 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "HBM bytes the decode loop streams)")
 
     def kv_quant_flag(sp):
-        # generate/bench only: the paged serving cache has no int8 path
-        # yet, so `serve` deliberately does not take the flag
         sp.add_argument("--kv-quant", choices=["none", "int8"],
                         default="none",
                         help="KV-cache quantization (int8 halves the cache "
                              "bytes — the dominant decode-loop term at "
-                             "serving batch sizes)")
+                             "serving batch sizes; applies to both the "
+                             "contiguous and the paged serving cache)")
 
     g = sub.add_parser("generate", help="one-shot text generation")
     common(g)
@@ -68,6 +67,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("serve", help="HTTP serving with continuous batching")
     common(s)
+    kv_quant_flag(s)
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--max-batch", type=int, default=8)

@@ -640,7 +640,8 @@ def run_server(args) -> int:
                        max_seq_len=args.max_seq, page_size=args.page_size,
                        top_k=args.top_k, top_p=args.top_p,
                        max_queue=args.max_queue,
-                       prefix_caching=getattr(args, "prefix_caching", False))
+                       prefix_caching=getattr(args, "prefix_caching", False),
+                       kv_quant=getattr(args, "kv_quant", "none"))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     sched = Scheduler(engine)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk

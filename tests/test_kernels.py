@@ -61,19 +61,54 @@ def test_flash_attention_bf16():
                                rtol=2e-2, atol=2e-2)
 
 
+def _gather_pool(pages, table):
+    """[P,Kv,page,H] pool -> [S, MP*page, Kv, H] dense view."""
+    S, MP = table.shape
+    P, Kv, page, H = pages.shape
+    return pages[table].transpose(0, 1, 3, 2, 4).reshape(S, MP * page, Kv, H)
+
+
 def test_paged_attention_parity():
     S, Nq, Kv, H, page, P, MP = 3, 8, 2, 16, 4, 10, 4
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
     q = jax.random.normal(ks[0], (S, Nq, H))
-    k_pages = jax.random.normal(ks[1], (P, page, Kv, H))
-    v_pages = jax.random.normal(ks[2], (P, page, Kv, H))
+    k_pages = jax.random.normal(ks[1], (P, Kv, page, H))
+    v_pages = jax.random.normal(ks[2], (P, Kv, page, H))
     table = jnp.asarray([[0, 2, 9, 9], [3, 1, 4, 9], [5, 6, 7, 8]],
                         jnp.int32)
     lengths = jnp.asarray([6, 3, 15], jnp.int32)
     out = paged_attention(q, k_pages, v_pages, table, lengths)
 
-    kk = k_pages[table].reshape(S, MP * page, Kv, H)
-    vv = v_pages[table].reshape(S, MP * page, Kv, H)
+    kk = _gather_pool(k_pages, table)
+    vv = _gather_pool(v_pages, table)
+    mask = jnp.arange(MP * page)[None, None, :] < lengths[:, None, None]
+    ref = attend(q[:, None], kk, vv, mask, None)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_int8_parity():
+    """Quantized pools (codes + flat kv-major scale rows) match the dense
+    int8 attend over the gathered view."""
+    from butterfly_tpu.models.common import quantize_kv
+
+    S, Nq, Kv, H, page, P, MP = 3, 8, 2, 16, 4, 10, 4
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(ks[0], (S, Nq, H))
+    kf = jax.random.normal(ks[1], (P, Kv, page, H))
+    vf = jax.random.normal(ks[2], (P, Kv, page, H))
+    kq, ksc = quantize_kv(kf)   # codes [P,Kv,page,H], scales [P,Kv,page]
+    vq, vsc = quantize_kv(vf)
+    ksp = ksc.reshape(P, Kv * page)
+    vsp = vsc.reshape(P, Kv * page)
+    table = jnp.asarray([[0, 2, 9, 9], [3, 1, 4, 9], [5, 6, 7, 8]],
+                        jnp.int32)
+    lengths = jnp.asarray([6, 3, 15], jnp.int32)
+    out = paged_attention(q, kq, vq, table, lengths, ksp, vsp)
+
+    # dense reference: dequantize the gathered view, plain attend
+    kk = _gather_pool(kq.astype(jnp.float32) * ksc[..., None], table)
+    vv = _gather_pool(vq.astype(jnp.float32) * vsc[..., None], table)
     mask = jnp.arange(MP * page)[None, None, :] < lengths[:, None, None]
     ref = attend(q[:, None], kk, vv, mask, None)[:, 0]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -84,7 +119,7 @@ def test_paged_attention_zero_length_slot():
     """length 0 (inactive slot) visits no pages and returns zeros."""
     S, Nq, Kv, H, page, P = 2, 4, 4, 8, 4, 4
     q = jax.random.normal(jax.random.PRNGKey(4), (S, Nq, H))
-    kp = jax.random.normal(jax.random.PRNGKey(5), (P, page, Kv, H))
+    kp = jax.random.normal(jax.random.PRNGKey(5), (P, Kv, page, H))
     table = jnp.zeros((S, 2), jnp.int32)
     out = paged_attention(q, kp, kp, table, jnp.asarray([0, 4], jnp.int32))
     assert np.isfinite(np.asarray(out)).all()

@@ -72,16 +72,64 @@ def test_inactive_slots_frozen():
 
 
 def test_write_gather_roundtrip():
-    k_pages = jnp.zeros((6, 4, 2, 3))
-    v_pages = jnp.zeros((6, 4, 2, 3))
+    k_pages = jnp.zeros((6, 2, 4, 3))  # [P, Kv, page, H]
+    v_pages = jnp.zeros((6, 2, 4, 3))
     table = jnp.asarray([[0, 2], [3, 1]], jnp.int32)  # interleaved pages
     k = jnp.arange(2 * 5 * 2 * 3, dtype=jnp.float32).reshape(2, 5, 2, 3)
     start = jnp.asarray([0, 3], jnp.int32)
     # slot1 writing at start=3 spills onto its second page (page id 1)
-    kp, vp = write_paged_layer(k_pages, v_pages, table, k, k * 2, start)
+    kp, vp, _, _ = write_paged_layer(k_pages, v_pages, table, k, k * 2, start)
     got = gather_paged_layer(kp, table)
     np.testing.assert_allclose(np.asarray(got[0, 0:5]), np.asarray(k[0]))
     np.testing.assert_allclose(np.asarray(got[1, 3:8]), np.asarray(k[1]))
+
+
+def test_write_gather_roundtrip_int8():
+    """Quantized write/gather: dequantized roundtrip within int8 error."""
+    from butterfly_tpu.cache.paged import gather_paged_layer_q
+
+    P, Kv, page, H = 6, 2, 4, 8
+    k_pages = jnp.zeros((P, Kv, page, H), jnp.int8)
+    v_pages = jnp.zeros((P, Kv, page, H), jnp.int8)
+    ksp = jnp.zeros((P, Kv * page))
+    vsp = jnp.zeros((P, Kv * page))
+    table = jnp.asarray([[0, 2], [3, 1]], jnp.int32)
+    k = jax.random.normal(jax.random.PRNGKey(0), (2, 5, Kv, H))
+    start = jnp.asarray([0, 3], jnp.int32)
+    kp, vp, ksp, vsp = write_paged_layer(k_pages, v_pages, table, k, k * 2,
+                                         start, None, ksp, vsp)
+    codes, scales = gather_paged_layer_q(kp, ksp, table)  # [B,Kv,S,*]
+    got = (codes.astype(jnp.float32) *
+           scales[..., None]).transpose(0, 2, 1, 3)       # [B,S,Kv,H]
+    np.testing.assert_allclose(np.asarray(got[0, 0:5]), np.asarray(k[0]),
+                               atol=2e-2)
+    np.testing.assert_allclose(np.asarray(got[1, 3:8]), np.asarray(k[1]),
+                               atol=2e-2)
+
+
+def test_paged_forward_int8_close_to_fp():
+    """int8 paged serving cache tracks the fp paged path closely and
+    EXACTLY matches the contiguous int8 cache's numerics contract
+    (scores scaled output-side, probs carry the V scale)."""
+    params = Model(CFG).init(jax.random.PRNGKey(0))
+    rt_q = RT.replace(kv_quant="int8")
+    cache_f = seq_table(init_paged_cache(CFG, RT), 2, 64 // RT.page_size)
+    cache_q = seq_table(init_paged_cache(CFG, rt_q), 2, 64 // RT.page_size)
+    assert cache_q.quantized and cache_q.k_pages.dtype == jnp.int8
+
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, CFG.vocab_size, (2, 9)))
+    ref, cache_f = paged_forward(params, CFG, tokens, cache_f)
+    out, cache_q = paged_forward(params, CFG, tokens, cache_q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.1, atol=0.15)
+
+    for _ in range(3):
+        nxt = jnp.argmax(ref[:, -1, :], axis=-1)[:, None]
+        ref, cache_f = paged_forward(params, CFG, nxt, cache_f)
+        out, cache_q = paged_forward(params, CFG, nxt, cache_q)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0.1, atol=0.15)
 
 
 def test_allocator_grow_release():

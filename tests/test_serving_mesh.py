@@ -90,7 +90,7 @@ def test_meshed_pool_is_sharded(params, mesh):
                         RuntimeConfig(max_batch_size=4, max_seq_len=64,
                                       page_size=8), mesh=mesh)
     spec = eng.cache.k_pages.sharding.spec
-    assert spec[3] == "tensor"  # kv-heads split over TP shards
+    assert spec[2] == "tensor"  # kv-heads split over TP shards
     assert eng.cache.page_table.sharding.spec[0] == "data"
 
 
@@ -135,7 +135,7 @@ def test_stage_pool_is_stage_sharded(params):
                                       page_size=8), mesh=mesh)
     spec = eng.cache.k_pages.sharding.spec
     assert spec[0] == "stage"   # each stage owns its layers' pages
-    assert spec[3] == "tensor"
+    assert spec[2] == "tensor"
 
 
 def test_stage_indivisible_layers_rejected(params):
