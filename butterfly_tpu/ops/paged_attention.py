@@ -132,7 +132,7 @@ def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
                                                    shardable_axes)
 
     S, Nq, H = q.shape
-    Kv = k_pages.shape[2]
+    Kv = k_pages.shape[1]          # pools are [P, Kv, page, H]
     d, t = shardable_axes(S, Nq, Kv)
     if d is None and t is None:
         if live_auto_mesh():

@@ -73,6 +73,28 @@ def test_meshed_scheduler_kernels_token_parity(params, mesh):
     assert [r.output for r in reqs] == [r.output for r in ref_reqs]
 
 
+def test_meshed_kernels_gqa_kv_smaller_than_tensor(mesh):
+    """Kv/page-dim mixup regression (round-4 ADVICE high): with pools
+    laid out [P, Kv, page, H], num_kv_heads=2 < tensor=4 while
+    page_size=8 IS tensor-divisible. shardable_axes must test Kv (2),
+    not page (8) — the kernel falls back to the gather path instead of
+    raising in shard_map — and tokens must match the unmeshed engine."""
+    cfg = tiny("llama", dtype="float32", param_dtype="float32",
+               num_heads=8, num_kv_heads=2, head_dim=8)
+    params = Model(cfg).init(jax.random.PRNGKey(7))
+    rt = RuntimeConfig(max_batch_size=4, max_seq_len=64, page_size=8)
+
+    ref = Scheduler(ServingEngine(Model(cfg), params, rt))
+    ref_reqs = [ref.submit(p, max_new_tokens=6) for p in PROMPTS]
+    ref.run_until_done()
+
+    sched = Scheduler(ServingEngine(Model(cfg), params, rt, mesh=mesh,
+                                    use_kernels=True))
+    reqs = [sched.submit(p, max_new_tokens=6) for p in PROMPTS]
+    sched.run_until_done()
+    assert [r.output for r in reqs] == [r.output for r in ref_reqs]
+
+
 def test_meshed_engine_flash_prefill_token_parity(params, mesh):
     """InferenceEngine flash prefill through shard_map on the mesh."""
     import numpy as np
