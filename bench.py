@@ -1,13 +1,18 @@
 #!/usr/bin/env python
 """Driver benchmark: one JSON line with the headline metric.
 
-Metric: steady-state decode throughput (tokens/sec/chip) for a ~1B-class
-Llama-3-style model in bfloat16 on the available chip(s) — the largest of
-the BASELINE.json model family that fits a single v5e chip's HBM with
-random weights. No published reference numbers exist (BASELINE.md: the
-reference is an unimplemented scaffold), so `vs_baseline` is the ratio to
-the first recorded run of this same benchmark (bench_baseline.json,
-committed after round 1) — i.e. it tracks our own improvement.
+Headline: steady-state decode throughput (tokens/sec/chip) for the
+BASELINE.json configs[1] model of record — Llama-3-8B geometry — in int8
+(weights + KV cache) on the available chip(s). Rounds 1-4 benchmarked a
+1.2B proxy; r5 moved to the 8B config of record, so `vs_baseline` is the
+ratio to the first 8B run (bench_baseline.json key "tpu_8b" — like the
+r1 baseline before it, it tracks our own improvement: the reference is
+an unimplemented scaffold with no published numbers, BASELINE.md).
+
+The same line also carries the PRODUCT serving-path numbers (VERDICT r4
+item 1): Scheduler + ServingEngine + paged Pallas kernel + int8 KV pools
+under staggered arrivals — serving tokens/sec/chip and TTFT/ITL
+percentiles, the BASELINE.md metrics of record.
 """
 import json
 import sys
@@ -18,33 +23,42 @@ BASELINE_FILE = Path(__file__).parent / "bench_baseline.json"
 
 def main() -> int:
     import jax
-    from butterfly_tpu.core.config import ModelConfig
+    from butterfly_tpu.core.config import llama3_8b, tiny
     from butterfly_tpu.models.common import Model
-    from butterfly_tpu.obs.benchmark import run_decode_benchmark
-    from butterfly_tpu.quant.int8 import quantize_int8
+    from butterfly_tpu.obs.benchmark import (run_decode_benchmark,
+                                             run_serving_benchmark)
+    from butterfly_tpu.quant.int8 import init_params_quantized
 
     on_tpu = jax.devices()[0].platform != "cpu"
 
     if on_tpu:
-        # ~1.2B params: fits one v5e chip (16 GiB HBM) in bf16 with cache.
-        cfg = ModelConfig(arch="llama", vocab_size=32000, hidden_size=2048,
-                          num_layers=16, num_heads=16, num_kv_heads=8,
-                          head_dim=128, intermediate_size=5632,
-                          max_seq_len=2048)
-        # batch 128 is the continuous-batching serving operating point
-        # where the decode loop peaks on v5e (~73% HBM roofline with the
-        # deferred-write decode path + int8 weights); 32 was ~0.27.
+        # Llama-3-8B geometry (BASELINE configs[1]): int8 weights ~8.5 GB
+        # fit one v5e chip's 16 GiB HBM with the int8 KV cache.
+        cfg = llama3_8b().replace(max_seq_len=2048)
         batch, prompt_len, max_new = 128, 128, 128
+        # decode_steps_per_tick=16: the scheduler chains 16 decode steps
+        # device-side per tick with ONE stacked token fetch — the dev
+        # tunnel's ~100 ms dispatch+fetch RTT would otherwise dominate
+        # every per-token readback (scheduler._inflight docs).
+        serving_kw = dict(n_requests=64, prompt_len=128, max_new=128,
+                          max_batch=32, decode_steps_per_tick=16)
+        baseline_key = "tpu_8b"
     else:
-        from butterfly_tpu.core.config import tiny
         cfg = tiny("llama", dtype="float32", param_dtype="float32")
         batch, prompt_len, max_new = 4, 32, 32
+        serving_kw = dict(n_requests=6, prompt_len=16, max_new=8,
+                          max_batch=4)
+        baseline_key = "cpu"
 
     model = Model(cfg)
-    params = model.init(jax.random.PRNGKey(0))
     # int8 weight-only quant: the serving default for the bandwidth-bound
-    # decode loop (CLI --quant int8); halves the weight bytes per step.
-    params = quantize_int8(params, cfg)
+    # decode loop (CLI --quant int8); initialized pre-quantized so the 8B
+    # float tree never materializes (init_params_quantized docs). Cast to
+    # the compute dtype ONCE here: both benchmark engines share this tree,
+    # and an engine-side cast would donate it out from under the other.
+    from butterfly_tpu.engine.engine import cast_params
+    params = cast_params(init_params_quantized(cfg, jax.random.PRNGKey(0)),
+                         cfg)
     # int8 KV cache + write-combined decode window (CLI --kv-quant int8):
     # halves the cache bytes — the dominant decode-loop term at this
     # batch — and amortizes the whole-pool copy each in-loop cache
@@ -53,27 +67,33 @@ def main() -> int:
     stats = run_decode_benchmark(model, params, batch=batch,
                                  prompt_len=prompt_len, max_new=max_new,
                                  kv_quant=kv_quant)
+    serving = run_serving_benchmark(model, params,
+                                    kv_quant="int8" if on_tpu else "none",
+                                    **serving_kw)
     toks_per_sec_chip = stats["tokens_per_sec_per_chip"]
 
     vs = 1.0
     if BASELINE_FILE.exists():
         base = json.loads(BASELINE_FILE.read_text())
-        key = "tpu" if on_tpu else "cpu"
-        if base.get(key):
-            vs = toks_per_sec_chip / base[key]
+        if base.get(baseline_key):
+            vs = toks_per_sec_chip / base[baseline_key]
 
-    print(json.dumps({
+    out = {
         "metric": "decode_tokens_per_sec_per_chip",
         "value": round(toks_per_sec_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs, 4),
+        "model": "llama3-8b" if on_tpu else "tiny",
         "quant": "int8",
         "kv_quant": kv_quant,
         "decode_isolated_tokens_per_sec_per_chip":
             round(stats["decode_tokens_per_sec_per_chip"], 2),
         "hbm_util": round(stats["hbm_util"], 4),
         "mfu": round(stats["mfu"], 4),
-    }))
+    }
+    for k, v in serving.items():
+        out[k] = round(v, 4) if isinstance(v, float) else v
+    print(json.dumps(out))
     return 0
 
 

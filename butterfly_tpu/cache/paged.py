@@ -254,7 +254,8 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
                   positions: Optional[jax.Array] = None,
                   active: Optional[jax.Array] = None,
                   use_kernel: bool = False,
-                  fresh: bool = False):
+                  fresh: bool = False,
+                  last_index: Optional[jax.Array] = None):
     """Forward over [B,T] tokens against the paged cache.
 
     B must equal cache.num_slots (serving: one row per slot). `active`
@@ -267,6 +268,10 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     attention kernel — touches only each slot's live pages instead of
     gathering the full S_max view. Prefills (T>1) honor cfg.attn_impl
     ("flash" = Pallas blockwise kernel over the fresh K/V).
+
+    last_index [B]: run the LM head only on each row's hidden state at
+    that index — logits come back [B,1,V] (models.common.forward docs:
+    the full-T head dominates prefill memory at LLM vocab sizes).
     """
     from butterfly_tpu.models.common import embed_tokens, final_logits, make_mask
 
@@ -295,6 +300,9 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     if quant:
         xs = xs + (cache.k_scale_pages, cache.v_scale_pages)
     x, new_pools = lax.scan(body, x, xs)
+    if last_index is not None:
+        x = jnp.take_along_axis(
+            x, last_index[:, None, None].astype(jnp.int32), axis=1)
     logits = final_logits(params, cfg, x)
     new_len = jnp.where(active, cache.lengths + T, cache.lengths)
     return logits, PagedKVCache(new_pools[0], new_pools[1],

@@ -117,13 +117,17 @@ class InferenceEngine:
         # Prefill steps are always fresh (new cache, positions 0..T-1), so
         # they may use the Pallas flash kernel (cfg.attn_impl contract).
         def make_fwd(cfg, fresh=False):
+            # last_index: last-token-only LM head (forward docs). The
+            # GPipe forward computes full logits per microbatch — it
+            # ignores the hint and the caller gathers afterwards.
             if mesh is not None and mesh.shape.get("stage", 1) > 1:
                 from butterfly_tpu.parallel.pipeline import pipeline_forward
-                return lambda p, t, c, pos=None: pipeline_forward(
-                    p, cfg, t, c, mesh, num_microbatches, pos, fresh=fresh,
-                    virtual_stages=virtual_stages)
-            return lambda p, t, c, pos=None: forward(p, cfg, t, c, pos,
-                                                     fresh=fresh)
+                return lambda p, t, c, pos=None, last_index=None: \
+                    pipeline_forward(
+                        p, cfg, t, c, mesh, num_microbatches, pos,
+                        fresh=fresh, virtual_stages=virtual_stages)
+            return lambda p, t, c, pos=None, last_index=None: forward(
+                p, cfg, t, c, pos, fresh=fresh, last_index=last_index)
 
         fwd = make_fwd(self.cfg)
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
@@ -150,7 +154,7 @@ class InferenceEngine:
         if self._decode_window > 1:
             self._generate_fused = jax.jit(
                 partial(_generate_fused_win, self.cfg, self._decode_window),
-                static_argnums=(4, 5),
+                static_argnums=(4, 5, 6),
                 donate_argnums=(2,),
             )
         else:
@@ -196,10 +200,18 @@ class InferenceEngine:
                 f"prompt ({tokens.shape[1]}) + max_new_tokens "
                 f"({sp.max_new_tokens}) = {total} exceeds the model's "
                 f"max_seq_len ({self.cfg.max_seq_len})")
-        # windowed fused decode rounds the step count up to a multiple of
-        # the window; the tail steps write (frozen) tokens past `total`
+        # Exact KV sizing: prefill writes T slots and the decode loop
+        # writes at most max(max_new, ceil(steps/C)*C) more (the windowed
+        # scan rounds the step count up to a multiple of the window; its
+        # tail steps write frozen tokens past `total`). Attention reads
+        # the WHOLE buffer every step, so slack rows are pure HBM
+        # traffic: `total + C - 1` cost 6% of the decode-loop bytes at
+        # the bench shape (S 271 vs 256).
+        steps = sp.max_new_tokens - 1
+        iters = -(-steps // self._decode_window) if steps else 0
         max_seq = max(self.runtime.max_seq_len,
-                      total + self._decode_window - 1)
+                      tokens.shape[1] + max(sp.max_new_tokens,
+                                            iters * self._decode_window))
         # Reuse the previous call's (donated-through) cache buffers when
         # the shape matches: a fresh pool pays allocation + memset of
         # ~GBs per call, and stale K/V is harmless — prefill overwrites
@@ -219,9 +231,18 @@ class InferenceEngine:
             first = sample(logits, first_key, sp)
 
             if fused:
-                out, lens, cache = self._generate_fused(self.params, first,
-                                                        cache, loop_key, sp,
-                                                        sp.max_new_tokens)
+                if self._decode_window > 1:
+                    # static flag: every row flushes at the same offset
+                    # (equal prompt lengths) -> one aliasable
+                    # scalar-offset cache write per flush group
+                    uniform = bool(np.all(true_lens == true_lens[0]))
+                    out, lens, cache = self._generate_fused(
+                        self.params, first, cache, loop_key, sp,
+                        sp.max_new_tokens, uniform)
+                else:
+                    out, lens, cache = self._generate_fused(
+                        self.params, first, cache, loop_key, sp,
+                        sp.max_new_tokens)
                 out, lens = np.asarray(out), np.asarray(lens)
             else:
                 toks = [np.asarray(first)]
@@ -363,11 +384,15 @@ class InferenceEngine:
 def _prefill_step(fwd, params, tokens, cache, true_lens):
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    logits, cache = fwd(params, tokens, cache, positions)
-    # gather last *real* token's logits; fix per-seq lengths
-    last = jnp.take_along_axis(logits, (true_lens - 1)[:, None, None], axis=1)
+    # last real token's logits only (forward last_index docs); paths
+    # that don't honor the hint return full-T logits — gather those.
+    logits, cache = fwd(params, tokens, cache, positions,
+                        last_index=true_lens - 1)
+    if logits.shape[1] != 1:
+        logits = jnp.take_along_axis(logits, (true_lens - 1)[:, None, None],
+                                     axis=1)
     cache = cache._replace(length=true_lens.astype(jnp.int32))
-    return last[:, 0, :], cache
+    return logits[:, 0, :], cache
 
 
 def _decode_step(fwd, params, token, cache, key, sp: SamplingParams):
@@ -409,47 +434,44 @@ def _generate_fused(fwd, params, first, cache, key,
 
 
 def _generate_fused_win(cfg: ModelConfig, C: int, params, first, cache, key,
-                        sp: SamplingParams, max_new: int):
+                        sp: SamplingParams, max_new: int,
+                        uniform: bool = False):
     """Write-combined fused generate: C decode steps per outer scan
-    iteration against (cache + window + self), then ONE ragged cache
-    write for all C tokens (flush_window). Token-for-token identical to
-    _generate_fused — the window stores the cache's exact representation
-    (int8 codes + scales in quant mode) and keys split in the same
-    order — while amortizing the dominant whole-pool copy the per-step
-    cache update costs on TPU (models/common.py window docs).
+    iteration against (cache + prior window steps + self), then ONE
+    ragged cache write for all C tokens (flush_window). Token-for-token
+    identical to _generate_fused — the window steps store the cache's
+    exact representation (int8 codes + scales in quant mode) and keys
+    split in the same order — while amortizing the dominant whole-pool
+    copy the per-step cache update costs on TPU (models/common.py
+    window docs). The C steps are unrolled, so the window is a plain
+    Python list of per-step K/V values — no device buffer, no carry.
     """
-    from butterfly_tpu.models.common import (
-        decode_step_win, decode_window_init, flush_window, window_insert)
+    from butterfly_tpu.models.common import decode_step_win, flush_window
 
     B = first.shape[0]
     steps = max_new - 1
     iters = -(-steps // C) if steps else 0
-    win = decode_window_init(cfg, B, C, cache.quantized,
-                             dtype=None if cache.quantized
-                             else cache.k.dtype)
-    quant = cache.quantized
 
     def body(carry, _):
-        cur, cache, wk, wv, wk_s, wv_s, key, done = carry
-        toks = []
+        cur, cache, key, done = carry
+        toks, window = [], []
         for j in range(C):
             key, sub = jax.random.split(key)
             logits, new_kv = decode_step_win(
-                params, cfg, cur[:, None], cache, wk, wv, wk_s, wv_s, j)
-            wk, wv, wk_s, wv_s = window_insert(
-                cfg, quant, wk, wv, wk_s, wv_s, new_kv, j)
+                params, cfg, cur[:, None], cache, window, j)
+            window.append(new_kv)
             nxt = sample(logits[:, -1, :], sub, sp)
             nxt = jnp.where(done, cur, nxt)
             if sp.stop_token >= 0:
                 done = done | (nxt == sp.stop_token)
             cur = nxt
             toks.append(nxt)
-        cache = flush_window(cache, wk, wv, wk_s, wv_s)
-        return (cur, cache, wk, wv, wk_s, wv_s, key, done), jnp.stack(toks)
+        cache = flush_window(cache, window, uniform=uniform)
+        return (cur, cache, key, done), jnp.stack(toks)
 
     done0 = (first == sp.stop_token) if sp.stop_token >= 0 \
         else jnp.zeros_like(first, dtype=bool)
-    carry0 = (first, cache, *win, key, done0)
+    carry0 = (first, cache, key, done0)
     (_, cache, *_), toks = jax.lax.scan(body, carry0, None, length=iters)
     toks = toks.reshape(iters * C, B)[:steps] if steps \
         else jnp.zeros((0, B), first.dtype)

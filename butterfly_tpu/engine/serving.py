@@ -198,6 +198,22 @@ class ServingEngine:
                       temps: np.ndarray, key: jax.Array
                       ) -> Tuple[np.ndarray, jax.Array]:
         """One decode step for every slot; returns (next tokens [S], logits)."""
+        nxt, logits = self.decode_active_async(tokens, active, temps, key)
+        return np.asarray(nxt), logits
+
+    def decode_active_async(self, tokens, active: np.ndarray,
+                            temps: np.ndarray, key: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array]:
+        """Dispatch one decode step WITHOUT host synchronization.
+
+        Returns the device-resident next-token vector [S]; feeding it
+        back as `tokens` of the next call chains steps entirely on the
+        device, so the host can dispatch step N+1 before reading step
+        N's tokens (sched/scheduler.py overlap — VERDICT r4 item 5:
+        the synchronous per-token readback made ITL host-bound at small
+        batch). `tokens` may be a host array or a previous call's
+        device vector.
+        """
         self._sync_table()
         with self._mesh_ctx():
             nxt, logits, cache = self._decode(
@@ -205,7 +221,7 @@ class ServingEngine:
                 jnp.asarray(active), jnp.asarray(temps),
                 self.runtime_top_k, self.runtime_top_p, key)
         self.cache = cache
-        return np.asarray(nxt), logits
+        return nxt, logits
 
     # static sampling knobs (per-slot temps are dynamic)
     @property
@@ -232,10 +248,15 @@ def _prefill_slot(cfg: ModelConfig, fresh: bool, fwd, params, tokens,
     B, T = tokens.shape
     positions = start[:, None] + jnp.broadcast_to(jnp.arange(T)[None, :],
                                                   (B, T))
-    logits, cache1 = fwd(params, cfg, tokens, cache1, positions, fresh=fresh)
-    last = jnp.take_along_axis(logits, (true_len - 1)[:, None, None], axis=1)
-    return last[:, 0, :], (cache1.k_pages, cache1.v_pages,
-                           cache1.k_scale_pages, cache1.v_scale_pages)
+    # last chunk token's logits only (paged_forward last_index docs);
+    # the pipeline path ignores the hint — gather its full-T logits.
+    logits, cache1 = fwd(params, cfg, tokens, cache1, positions, fresh=fresh,
+                         last_index=true_len - 1)
+    if logits.shape[1] != 1:
+        logits = jnp.take_along_axis(logits, (true_len - 1)[:, None, None],
+                                     axis=1)
+    return logits[:, 0, :], (cache1.k_pages, cache1.v_pages,
+                             cache1.k_scale_pages, cache1.v_scale_pages)
 
 
 def _decode_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,

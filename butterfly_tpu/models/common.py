@@ -488,8 +488,7 @@ def decode_attend(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                   wk: Optional[jax.Array] = None,
                   wv: Optional[jax.Array] = None,
                   wk_s: Optional[jax.Array] = None,
-                  wv_s: Optional[jax.Array] = None,
-                  wlen: Optional[int] = None) -> jax.Array:
+                  wv_s: Optional[jax.Array] = None) -> jax.Array:
     """One-token attention over (old cache) + (the token itself).
 
     The general path writes K/V into the cache BEFORE attending, which
@@ -507,12 +506,13 @@ def decode_attend(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     into the dots) and the self term stays full precision.
 
     Window (write-combining fused decode, engine._generate_fused): wk/wv
-    hold the last `wlen` decoded tokens' K/V not yet flushed into the
-    big cache, in the SAME representation and dim order as the cache
-    but with a small step-indexed axis of static size C (fp: [B,C,Kv,H];
-    int8: [B,Kv,C,H] + wk_s/wv_s [B,Kv,C]). They sit at absolute
-    positions start..start+wlen-1; entries >= wlen are masked. `start`
-    is the FLUSHED length per row (= tokens actually in ck/cv).
+    hold the previous not-yet-flushed decoded tokens' K/V for this
+    layer, in the cache's REPRESENTATION (int8 codes + scales in quant
+    mode), stacked step-major: [W,B,Kv,H] both modes, scales wk_s/wv_s
+    [W,B,Kv]. Every entry is LIVE (the unrolled fused loop passes
+    exactly the steps decoded so far — see decode_step_win); they sit
+    at absolute positions start..start+W-1. `start` is the FLUSHED
+    length per row (= tokens actually in ck/cv).
     """
     B, _, Nq, H = q.shape
     quant = k_s is not None
@@ -533,15 +533,11 @@ def decode_attend(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     parts_s = [s_c]
 
     if wk is not None:
-        C = wk.shape[2] if quant else wk.shape[1]
-        s_w = jnp.einsum(f"bkgh,{'bkch' if quant else 'bckh'}->bkgc",
-                         qg, _cast_float(wk, compute),
+        s_w = jnp.einsum("bkgh,cbkh->bkgc", qg, _cast_float(wk, compute),
                          preferred_element_type=jnp.float32)
         if quant:
-            s_w = s_w * wk_s[:, :, None, :]
+            s_w = s_w * jnp.moveaxis(wk_s, 0, -1)[:, :, None, :]
         s_w = s_w * scale
-        s_w = jnp.where(jnp.arange(C)[None, None, None, :] < wlen,
-                        s_w, -1e30)
         parts_s.append(s_w)
 
     s_self = jnp.sum(qg.astype(jnp.float32) *
@@ -559,41 +555,36 @@ def decode_attend(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     if wk is not None:
         p_w = p[..., S:-1]
         if quant:
-            p_w = p_w * wv_s[:, :, None, :]
-        out = out + jnp.einsum(f"bkgc,{'bkch' if quant else 'bckh'}->bkgh",
-                               p_w.astype(compute),
+            p_w = p_w * jnp.moveaxis(wv_s, 0, -1)[:, :, None, :]
+        out = out + jnp.einsum("bkgc,cbkh->bkgh", p_w.astype(compute),
                                _cast_float(wv, compute))
     out = out + p[..., -1:].astype(v_new.dtype) * v_new.reshape(B, Kv, 1, H)
     return out.reshape(B, 1, Nq, H)
 
 
 def _decode_layer_body(x, lp, cfg: ModelConfig, cache: KVCache, i,
-                       cos, sin, start, wk=None, wv=None, wk_s=None,
-                       wv_s=None, wlen=None):
+                       cos, sin, start, wk_i=None, wv_i=None, wks_i=None,
+                       wvs_i=None):
     """One decode layer against layer `i`'s slice of the closed-over
-    cache (+ optional write-combining window slice). The single layer
-    body shared by _decode_forward and decode_step_win so the per-step
-    and windowed decode paths cannot drift. Returns (x, k_new, v_new)
-    with k/v [B,1,Kv,H] in compute dtype.
+    cache (+ optional write-combining window entries for THIS layer:
+    wk_i/wv_i [W,B,Kv,H], scales [W,B,Kv] — already layer-sliced by the
+    caller). The single layer body shared by _decode_forward and
+    decode_step_win so the per-step and windowed decode paths cannot
+    drift. Returns (x, k_new, v_new) with k/v [B,1,Kv,H] in compute
+    dtype.
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
     ck = lax.dynamic_index_in_dim(cache.k, i, 0, keepdims=False)
     cv = lax.dynamic_index_in_dim(cache.v, i, 0, keepdims=False)
-    k_s = v_s = wk_i = wv_i = wks_i = wvs_i = None
+    k_s = v_s = None
     if cache.quantized:
         k_s = lax.dynamic_index_in_dim(cache.k_scale, i, 0, keepdims=False)
         v_s = lax.dynamic_index_in_dim(cache.v_scale, i, 0, keepdims=False)
-        if wk_s is not None:
-            wks_i = lax.dynamic_index_in_dim(wk_s, i, 0, keepdims=False)
-            wvs_i = lax.dynamic_index_in_dim(wv_s, i, 0, keepdims=False)
-    if wk is not None:
-        wk_i = lax.dynamic_index_in_dim(wk, i, 0, keepdims=False)
-        wv_i = lax.dynamic_index_in_dim(wv, i, 0, keepdims=False)
     h = pre_norm(x, lp["ln1"], cfg)
     q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
     out = decode_attend(q, k, v, ck, cv, start, cfg, k_s, v_s,
-                        wk_i, wv_i, wks_i, wvs_i, wlen)
+                        wk_i, wv_i, wks_i, wvs_i)
     x = x + attn_output(out, lp["attn"], cfg)
     x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
     return x, k, v
@@ -669,46 +660,51 @@ def _decode_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 # TPU (XLA does not alias scatters into while-loop carries here; measured
 # ~2.4 ms/step at the 1B/batch-128 operating point — the largest single
 # term of the decode step). The fused generate therefore decodes C tokens
-# into a small step-indexed WINDOW (scalar-offset updates into a buffer
-# ~S/C the size) and flushes all C tokens into the big cache with ONE
-# ragged write per C steps, amortizing the copy. The window uses the same
-# representation as the cache (int8 codes + scales in quant mode), so
-# attention numerics are bit-identical to the step-by-step path.
+# per outer scan iteration and flushes all C into the big cache with ONE
+# ragged write per C steps, amortizing the copy. The C steps are UNROLLED
+# inside the iteration, so the not-yet-flushed "window" needs no device
+# buffer at all: each step's K/V is an SSA value held in a Python list
+# (r4 had a [.., C, ..] window buffer updated per step with
+# dynamic-update-slice; XLA's layout assignment made every insert a
+# strided scatter of H-byte segments at 15 GiB/s — 19% of the decode step
+# on v5e, docs/decode_profile_r5.md — and reassigned any step-major
+# layout right back). The window uses the cache's representation (int8
+# codes + scales in quant mode), so attention numerics are bit-identical
+# to the step-by-step path.
 # ---------------------------------------------------------------------------
 
-def decode_window_init(cfg: ModelConfig, batch: int, C: int, quant: bool,
-                       dtype=None):
-    """Empty window buffers: (wk, wv, wk_s, wv_s) — scales None if fp."""
-    L, Kv, H = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
-    if quant:
-        return (jnp.zeros((L, batch, Kv, C, H), jnp.int8),
-                jnp.zeros((L, batch, Kv, C, H), jnp.int8),
-                jnp.zeros((L, batch, Kv, C), jnp.float32),
-                jnp.zeros((L, batch, Kv, C), jnp.float32))
-    dtype = dtype or jnp.dtype(cfg.dtype)
-    return (jnp.zeros((L, batch, C, Kv, H), dtype),
-            jnp.zeros((L, batch, C, Kv, H), dtype), None, None)
-
-
 def decode_step_win(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                    cache: KVCache, wk, wv, wk_s, wv_s, wstep: int):
-    """One decode step against (cache + window + self); no writes.
+                    cache: KVCache, prev: list, wstep: int):
+    """One decode step against (cache + prior window steps + self).
 
     tokens [B,1]; the token sits at absolute position cache.length +
-    wstep (cache.length = flushed tokens; window holds steps 0..wstep-1
-    of the current flush group). Returns (logits, new_kv) where new_kv
-    is the per-layer stacked K/V of this token in window representation:
+    wstep (cache.length = flushed tokens; `prev` holds steps
+    0..wstep-1 of the current flush group as a list of new_kv tuples —
+    exactly what this function returned for them). No cache writes.
+    Returns (logits, new_kv): the per-layer stacked K/V of this token —
     fp (ks [L,B,Kv,H], vs) / quant (kq, vq, ks_scale [L,B,Kv], vs_scale).
+
+    The prior steps are stacked ONCE per step into [L,W,...] arrays and
+    ride into the layer scan as `xs` leaves, so each layer's xs slice is
+    one CONTIGUOUS [W,B,Kv,H] window operand for decode_attend (stacking
+    inside the layer body instead costs ~2x the step's window traffic in
+    128KB strided slices + concats — measured on v5e, r5 profile).
     """
     quant = cache.quantized
     positions = (cache.length + wstep)[:, None]
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     start = cache.length
+    win = ()
+    if prev:  # [L,W,B,Kv,H] codes (+ [L,W,B,Kv] scales in quant mode)
+        win = tuple(jnp.stack(c, axis=1) for c in zip(*prev))
 
-    def layer(carry, lp):
+    def layer(carry, scanned):
         x, i = carry
+        lp, w = scanned  # w: per-layer [W,B,Kv,H] (+ [W,B,Kv]) or ()
+        wk_i, wv_i, *wsc = w if w else (None, None)
+        wks_i, wvs_i = wsc if wsc else (None, None)
         x, k, v = _decode_layer_body(x, lp, cfg, cache, i, cos, sin, start,
-                                     wk, wv, wk_s, wv_s, wstep)
+                                     wk_i, wv_i, wks_i, wvs_i)
         if quant:
             kq, ksc = quantize_kv(k)
             vq, vsc = quantize_kv(v)
@@ -716,39 +712,42 @@ def decode_step_win(params: Params, cfg: ModelConfig, tokens: jax.Array,
         return (x, i + 1), (k[:, 0].astype(cache.k.dtype),
                             v[:, 0].astype(cache.v.dtype))
 
-    (x, _), new_kv = lax.scan(layer, (x, 0), params["layers"])
+    (x, _), new_kv = lax.scan(layer, (x, 0), (params["layers"], win))
     return final_logits(params, cfg, x), new_kv
 
 
-def window_insert(cfg: ModelConfig, quant: bool, wk, wv, wk_s, wv_s,
-                  new_kv, wstep: int):
-    """Write one step's K/V into window slot `wstep` (scalar offset —
-    cheap even though it copies the small window buffer)."""
-    if quant:
-        kq, vq, ksc, vsc = new_kv  # kq [L,B,Kv,H], ksc [L,B,Kv]
-        wk = lax.dynamic_update_slice(wk, kq[:, :, :, None, :],
-                                      (0, 0, 0, wstep, 0))
-        wv = lax.dynamic_update_slice(wv, vq[:, :, :, None, :],
-                                      (0, 0, 0, wstep, 0))
-        wk_s = lax.dynamic_update_slice(wk_s, ksc[:, :, :, None],
-                                        (0, 0, 0, wstep))
-        wv_s = lax.dynamic_update_slice(wv_s, vsc[:, :, :, None],
-                                        (0, 0, 0, wstep))
-        return wk, wv, wk_s, wv_s
-    ks, vs = new_kv  # [L,B,Kv,H]
-    wk = lax.dynamic_update_slice(wk, ks[:, :, None, :, :],
-                                  (0, 0, wstep, 0, 0))
-    wv = lax.dynamic_update_slice(wv, vs[:, :, None, :, :],
-                                  (0, 0, wstep, 0, 0))
-    return wk, wv, None, None
+def flush_window(cache: KVCache, steps: list,
+                 uniform: bool = False) -> KVCache:
+    """Write a whole flush group (C tokens per row, `steps` = the list of
+    decode_step_win new_kv tuples) into the big cache at each row's
+    flushed length — the one ragged write per C steps. The stack into
+    cache dim order is a copy of the small window only, amortized over
+    C steps.
 
-
-def flush_window(cache: KVCache, wk, wv, wk_s, wv_s) -> KVCache:
-    """Write the whole window (C tokens per row) into the big cache at
-    each row's flushed length — the one ragged write per C steps."""
+    `uniform` (static) asserts every row's flushed length is equal (all
+    prompts the same length — the batch-benchmark shape). The update is
+    then ONE dynamic_update_slice at a scalar offset, which XLA aliases
+    with the scan carry and performs in place; the general ragged path
+    (vmapped per-row updates) rolls into a loop whose first update
+    COPIES each pool — ~1.8 ms per pool per flush at the 1B/batch-128
+    operating point (docs/decode_profile_r5.md)."""
     start = cache.length
-    C = wk.shape[3] if cache.quantized else wk.shape[2]
+    C = len(steps)
     if cache.quantized:
+        kq = jnp.stack([s[0] for s in steps], axis=3)   # [L,B,Kv,C,H]
+        vq = jnp.stack([s[1] for s in steps], axis=3)
+        ksc = jnp.stack([s[2] for s in steps], axis=3)  # [L,B,Kv,C]
+        vsc = jnp.stack([s[3] for s in steps], axis=3)
+        if uniform:
+            s0 = start[0]
+            new_k = lax.dynamic_update_slice(cache.k, kq, (0, 0, 0, s0, 0))
+            new_v = lax.dynamic_update_slice(cache.v, vq, (0, 0, 0, s0, 0))
+            new_ks = lax.dynamic_update_slice(cache.k_scale, ksc,
+                                              (0, 0, 0, s0))
+            new_vs = lax.dynamic_update_slice(cache.v_scale, vsc,
+                                              (0, 0, 0, s0))
+            return KVCache(new_k, new_v, cache.length + C, new_ks, new_vs)
+
         def updq(c_b, n_b, s_b):  # [L,Kv,S,H] <- [L,Kv,C,H] at (0,0,s,0)
             return lax.dynamic_update_slice(c_b, n_b, (0, 0, s_b, 0))
 
@@ -756,26 +755,105 @@ def flush_window(cache: KVCache, wk, wv, wk_s, wv_s) -> KVCache:
             return lax.dynamic_update_slice(c_b, n_b, (0, 0, s_b))
 
         new_k = jax.vmap(updq, in_axes=(1, 1, 0), out_axes=1)(
-            cache.k, wk, start)
+            cache.k, kq, start)
         new_v = jax.vmap(updq, in_axes=(1, 1, 0), out_axes=1)(
-            cache.v, wv, start)
+            cache.v, vq, start)
         new_ks = jax.vmap(upd_s, in_axes=(1, 1, 0), out_axes=1)(
-            cache.k_scale, wk_s, start)
+            cache.k_scale, ksc, start)
         new_vs = jax.vmap(upd_s, in_axes=(1, 1, 0), out_axes=1)(
-            cache.v_scale, wv_s, start)
+            cache.v_scale, vsc, start)
         return KVCache(new_k, new_v, cache.length + C, new_ks, new_vs)
+
+    ks = jnp.stack([s[0] for s in steps], axis=2)       # [L,B,C,Kv,H]
+    vs = jnp.stack([s[1] for s in steps], axis=2)
+    if uniform:
+        s0 = start[0]
+        new_k = lax.dynamic_update_slice(cache.k, ks, (0, 0, s0, 0, 0))
+        new_v = lax.dynamic_update_slice(cache.v, vs, (0, 0, s0, 0, 0))
+        return KVCache(new_k, new_v, cache.length + C)
 
     def upd(c_b, n_b, s_b):  # [L,S,Kv,H] <- [L,C,Kv,H] at (0,s,0,0)
         return lax.dynamic_update_slice(c_b, n_b, (0, s_b, 0, 0))
 
-    new_k = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.k, wk, start)
-    new_v = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.v, wv, start)
+    new_k = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.k, ks, start)
+    new_v = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(cache.v, vs, start)
     return KVCache(new_k, new_v, cache.length + C)
+
+
+def _fresh_prefill_forward(params: Params, cfg: ModelConfig,
+                           tokens: jax.Array, cache: KVCache, positions,
+                           last_index) -> Tuple[jax.Array, KVCache]:
+    """Fresh-prefill fast path: the cache stays OUT of the layer scan.
+
+    A fresh prefill (positions 0..T-1, nothing live in the cache) never
+    READS the cache — attention is over the freshly-projected K/V (flash
+    kernel, or a dense causal fallback over the same bf16 values). So
+    the pools ride the scan CARRY and each layer writes its (already
+    cache-representation) K/V with one dynamic_update_slice at the
+    layer index — XLA's canonical in-place carry update. The general
+    path instead threads pools as scan xs/ys: the xs slicing copies a
+    layer slice per step and the stacked ys make a SECOND full pool —
+    2x pool HBM, the term that pushed 8B/batch-128 prefill over a v5e
+    chip's 16 GiB.
+
+    Padded rows: like the general path, pad positions' K/V land in the
+    cache; they sit at slots >= true_len that no causal query reaches
+    until decode overwrites them (engine/engine.py padding contract).
+    """
+    B, T = tokens.shape
+    quant = cache.quantized
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    mask = make_mask(positions, T)  # causal over the chunk itself
+
+    def body(carry, lp):
+        x, pools, i = carry
+        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
+        h = pre_norm(x, lp["ln1"], cfg)
+        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+        out = None
+        if cfg.attn_impl == "flash" and T > 1:
+            from butterfly_tpu.ops.flash_attention import (
+                flash_attention_sharded)
+            out = flash_attention_sharded(q, k, v, causal=True)
+        if out is None:
+            out = attend(q, k, v, mask, cfg)
+        x = x + attn_output(out, lp["attn"], cfg)
+        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        ck, cv, cks, cvs = pools
+        if quant:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            ck = lax.dynamic_update_slice(
+                ck, kq.transpose(0, 2, 1, 3)[None], (i, 0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(
+                cv, vq.transpose(0, 2, 1, 3)[None], (i, 0, 0, 0, 0))
+            cks = lax.dynamic_update_slice(
+                cks, ks.transpose(0, 2, 1)[None], (i, 0, 0, 0))
+            cvs = lax.dynamic_update_slice(
+                cvs, vs.transpose(0, 2, 1)[None], (i, 0, 0, 0))
+        else:
+            ck = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype)[None], (i, 0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype)[None], (i, 0, 0, 0, 0))
+        return (x, (ck, cv, cks, cvs), i + 1), None
+
+    pools0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    (x, pools, _), _ = lax.scan(body, (x, pools0, 0), params["layers"])
+    if last_index is not None:
+        x = jnp.take_along_axis(
+            x, last_index[:, None, None].astype(jnp.int32), axis=1)
+    logits = final_logits(params, cfg, x)
+    new_len = cache.length + T
+    return logits, KVCache(pools[0], pools[1], new_len, pools[2], pools[3])
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             cache: KVCache, positions: Optional[jax.Array] = None,
-            fresh: bool = False) -> Tuple[jax.Array, KVCache]:
+            fresh: bool = False,
+            last_index: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, KVCache]:
     """Run the model over `tokens` [B,T], reading/updating `cache`.
 
     positions defaults to cache.length[:,None] + arange(T) (append).
@@ -786,18 +864,31 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     Single-token warm calls take the decode fast path (_decode_forward:
     deferred one-shot cache write). Returns (logits [B,T,V] float32,
     updated cache).
+
+    last_index [B]: when given, the LM head runs ONLY on each row's
+    hidden state at that (row-relative) index — logits come back
+    [B,1,V]. Prefill needs just the last real token's logits, and the
+    full-T head is the single largest prefill term at LLM vocab sizes
+    (8B/V=128k at B=T=128: an 8.4 GB f32 [B,T,V] buffer plus 6% of the
+    prefill FLOPs).
     """
     B, T = tokens.shape
     if positions is None:
         positions = cache.length[:, None] + jnp.arange(T)[None, :]
     if T == 1 and not fresh:
         return _decode_forward(params, cfg, tokens, cache, positions)
+    if fresh and T > 1:
+        return _fresh_prefill_forward(params, cfg, tokens, cache,
+                                      positions, last_index)
 
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)
     x, *new_kv = scan_layers(params["layers"], cfg, x, cache.k, cache.v,
                              positions, mask, cos, sin, fresh,
                              cache.k_scale, cache.v_scale)
+    if last_index is not None:
+        x = jnp.take_along_axis(
+            x, last_index[:, None, None].astype(jnp.int32), axis=1)
     logits = final_logits(params, cfg, x)
     new_len = cache.length + T
     return logits, KVCache(*new_kv[:2], new_len, *new_kv[2:])

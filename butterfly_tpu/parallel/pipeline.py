@@ -155,9 +155,14 @@ def paged_pipeline_forward(params: Params, cfg: ModelConfig,
                            positions: Optional[jax.Array] = None,
                            active: Optional[jax.Array] = None,
                            use_kernel: bool = False, fresh: bool = False,
+                           last_index: Optional[jax.Array] = None,
                            *, mesh: Mesh,
                            num_microbatches: Optional[int] = None):
-    """paged_forward pipelined over `stage` (PP serving, VERDICT r2 item 4).
+    """paged_forward pipelined over `stage` (VERDICT r2 item 4).
+
+    `last_index` is accepted for signature parity with paged_forward but
+    ignored — the GPipe schedule emits full-T logits per microbatch and
+    the caller gathers (engine/serving.py _prefill_slot).
 
     Same contract as cache.paged.paged_forward — [B,T] tokens against the
     shared page pool — but the layer stack and the pool's L dim are stage-
@@ -174,7 +179,7 @@ def paged_pipeline_forward(params: Params, cfg: ModelConfig,
     S = mesh.shape["stage"]
     if S == 1:
         return paged_forward(params, cfg, tokens, cache, positions, active,
-                             use_kernel, fresh)
+                             use_kernel, fresh, last_index)
     B, T = tokens.shape
     if positions is None:
         positions = cache.lengths[:, None] + jnp.arange(T)[None, :]

@@ -133,6 +133,120 @@ def quantize_int8(params: Params, cfg) -> Params:
     return go(params)
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("shape", "axes", "dt"))
+def _init_quant_leaf(k, shape, axes, dt):
+    w = jax.random.normal(k, shape, jnp.float32) * 0.02
+    return _quant(w, axes, dt)
+
+
+@_partial(jax.jit, static_argnames=("shape", "pdt", "kind"))
+def _init_plain_leaf(k, shape, pdt, kind):
+    if kind == "ones":
+        return jnp.ones(shape, pdt)
+    if kind == "zeros":
+        return jnp.zeros(shape, pdt)
+    return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(pdt)
+
+
+def init_params_quantized(cfg, key: jax.Array) -> Params:
+    """Random-init an already-int8-quantized tree without ever holding
+    the float tree in HBM.
+
+    `init_params` + `quantize_int8` as two device programs peaks at the
+    full master-dtype tree (8B f32 = 32 GB — double a v5e chip's HBM);
+    fusing them into one jit does NOT help — XLA schedules the cheap
+    RNG ops ahead of the quantizations and materializes the float tree
+    anyway (measured: the fused program ResourceExhausted a v5e).
+    So each leaf is its own tiny program: init one float leaf,
+    quantize, free — peak = int8 tree + one float leaf. Leaf roles
+    (matmul -> quantize with quantize_int8's contraction axes;
+    norm-scales -> ones; biases -> zeros; everything else -> N(0, .02))
+    are resolved by path over init_params' eval_shape tree, so the
+    structure can't drift from the real initializer. Benchmark/smoke
+    use (real deployments load checkpoints via ckpt/)."""
+    from butterfly_tpu.models.common import init_params
+
+    dt = jnp.dtype(cfg.dtype)
+    shapes = jax.eval_shape(_partial(init_params, cfg),
+                            jax.ShapeDtypeStruct(key.shape, key.dtype))
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    keys = jax.random.split(key, len(leaves))
+    out = []
+    for (path, sd), k in zip(leaves, keys):
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        name, parent = names[-1], names[-2] if len(names) > 1 else ""
+        if name in ("wq", "wk", "wv"):
+            axes = (1,)
+        elif name == "wo":
+            axes = (1, 2)
+        elif parent == "moe" and name in ("w_gate", "w_up", "w_down"):
+            axes = (2,)
+        elif parent == "mlp" and name in ("w_gate", "w_up", "w_down"):
+            axes = (1,)
+        elif name == "lm_head":
+            axes = (0,)
+        else:
+            axes = None
+        if axes is not None:
+            out.append(_init_quant_chunked(k, sd.shape, axes, dt))
+        else:
+            kind = "ones" if name == "scale" else \
+                "zeros" if name.startswith("b") else "normal"
+            out.append(_init_plain_chunked(k, sd.shape, sd.dtype, kind))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+#: Per-program element budget for random init: the RNG's bit buffers and
+#: the f32 intermediate are ~3x the leaf, so one 525M-element vocab leaf
+#: (8B lm_head/embed) spikes ~6 GB — chunking bounds the transient.
+_INIT_CHUNK_ELEMS = 128 * 2**20
+
+
+def _chunks(k, shape, ax):
+    n = shape[ax]
+    size = 1
+    for s in shape:
+        size *= s
+    nchunks = min(n, -(-size // _INIT_CHUNK_ELEMS))
+    if nchunks <= 1:
+        return None
+    csize = -(-n // nchunks)
+    keys = jax.random.split(k, nchunks)
+    spans = []
+    lo = 0
+    while lo < n:
+        spans.append((keys[len(spans)], min(csize, n - lo)))
+        lo += csize
+    return spans
+
+def _init_quant_chunked(k, shape, axes, dt):
+    # chunk along the largest non-contracted axis: per-output-channel
+    # scales make chunks exactly independent
+    ax = max((d for d in range(len(shape)) if d not in axes),
+             key=lambda d: shape[d])
+    spans = _chunks(k, shape, ax)
+    if spans is None:
+        return _init_quant_leaf(k, shape, axes, dt)
+    parts = []
+    for ck, clen in spans:
+        cshape = tuple(clen if d == ax else s for d, s in enumerate(shape))
+        parts.append(_init_quant_leaf(ck, cshape, axes, dt))
+    return {"q8": jnp.concatenate([p["q8"] for p in parts], axis=ax),
+            "s": jnp.concatenate([p["s"] for p in parts], axis=ax)}
+
+
+def _init_plain_chunked(k, shape, pdt, kind):
+    spans = _chunks(k, shape, 0) if kind == "normal" and shape else None
+    if spans is None:
+        return _init_plain_leaf(k, shape, pdt, kind)
+    parts = [_init_plain_leaf(ck, (clen,) + tuple(shape[1:]), pdt, kind)
+             for ck, clen in spans]
+    return jnp.concatenate(parts, axis=0)
+
+
 def quant_specs_like(qparams: Params, specs: Params) -> Params:
     """Mirror a param_specs tree onto a quantized tree.
 

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from butterfly_tpu.cache.allocator import make_page_allocator
@@ -101,6 +102,26 @@ class Scheduler:
         self._ids = itertools.count()
         self._key = jax.random.PRNGKey(seed)
         self._next_tokens = np.zeros((engine.num_slots,), np.int32)
+        # In-flight decode steps: [(device token vector, slot->request
+        # snapshot), ...] in dispatch order. Each step is dispatched
+        # chained on the previous step's DEVICE tokens, so a whole
+        # tick's decode_steps_per_tick steps run back-to-back on the
+        # device with no host round trip; the host drains them all in
+        # ONE stacked fetch at the next tick's start. One fetch per
+        # tick instead of one per token is what makes the decode loop
+        # survive high host<->device latency (the dev tunnel here has
+        # ~100 ms dispatch+fetch RTT; real hosts still save the
+        # per-step sync).
+        self._inflight: List[tuple] = []
+        # First tokens sampled on-device at admission, not yet fetched:
+        # [(req, generation=req.preemptions, slot, device scalar)].
+        # Fetched with the same stacked drain (a per-admission host
+        # fetch would pay the full dispatch+fetch RTT per request).
+        self._pending_first: List[tuple] = []
+        # Device twin of _next_tokens: the decode chain's input vector.
+        # Admissions write their first token into it with a device-side
+        # .at[].set, so dispatching never needs the host values.
+        self._next_dev = None
         self._metrics: Dict[str, float] = {
             "requests_total": 0, "requests_finished": 0,
             "tokens_generated_total": 0, "preemptions_total": 0,
@@ -161,6 +182,9 @@ class Scheduler:
         """Wedge-path drain: host-only bookkeeping, NO device calls (the
         device may be the thing that's broken). Every waiter's on_finish
         fires; slots/pages are reclaimed in host state only."""
+        # never block on a possibly-wedged device
+        self._inflight = []
+        self._pending_first = []
         for req in self.unfinished_requests():
             req.state = "cancelled"
             req.t_finish = time.monotonic()
@@ -198,6 +222,10 @@ class Scheduler:
         admission pressure. Returns the number of tokens generated this
         round (throughput accounting for the serve loop)."""
         before = self._metrics["tokens_generated_total"]
+        # consume any step still in flight BEFORE admission: admission
+        # must see finished slots, and a prefill dispatched over a stale
+        # in-flight step would race the table sync
+        self._drain_inflight()
         self._admit()
         for _ in range(max(1, self.engine.runtime.decode_steps_per_tick)):
             if self.running:
@@ -282,7 +310,10 @@ class Scheduler:
 
             # prompt fully in cache: publish its full pages for prefix
             # reuse (no-op without prefix caching), sample the first
-            # token, start decoding
+            # token ON DEVICE, start decoding. The token is fetched at
+            # the next stacked drain; even a max_new==1 request keeps
+            # its slot until then (its extra decode steps are discarded
+            # like any post-finish in-flight work).
             self.alloc.register(req.slot, prefix)
             self._prefilling = None
             req.state = "running"
@@ -291,16 +322,28 @@ class Scheduler:
             first = sample_batched(
                 logits[None], sub,
                 np.asarray([req.temperature], np.float32),
-                self.engine.runtime_top_k, self.engine.runtime_top_p)
-            self._emit(req, int(first[0]))
-            if req.slot is not None:  # may have finished on max_new==1
-                self._next_tokens[req.slot] = int(first[0])
+                self.engine.runtime_top_k, self.engine.runtime_top_p)[0]
+            base = self._next_dev if self._next_dev is not None \
+                else jnp.asarray(self._next_tokens)
+            self._next_dev = base.at[req.slot].set(first)
+            self._pending_first.append(
+                (req, req.preemptions, req.slot, first))
 
     def _decode_step(self) -> None:
-        # just-in-time page growth (may preempt the youngest requests)
+        # just-in-time page growth (may preempt the youngest requests).
+        # The host's view lags the in-flight queue, so this dispatch may
+        # write len(queue)+1 positions past what all_tokens implies —
+        # but never more than the request's lifetime maximum (clamping
+        # matters: a request sized exactly to the per-seq page cap would
+        # otherwise self-preempt forever chasing unneeded slack; its
+        # post-finish in-flight writes beyond the table land on the
+        # null page by construction, cache/paged.write_paged_layer).
+        depth = len(self._inflight)
         for req in list(self.running):
             if req in self.running:  # may have been preempted as a victim
-                self._ensure_or_preempt(req, len(req.all_tokens) + 1)
+                need = min(len(req.all_tokens) + depth + 2,
+                           len(req.prompt) + req.max_new_tokens)
+                self._ensure_or_preempt(req, need)
         if not self.running:
             return
 
@@ -310,12 +353,55 @@ class Scheduler:
             active[req.slot] = True
             temps[req.slot] = req.temperature
         self._key, sub = jax.random.split(self._key)
-        nxt, _ = self.engine.decode_active(self._next_tokens, active, temps,
-                                           sub)
-        for req in list(self.running):
-            slot = req.slot  # _emit clears it when the request finishes
-            self._next_tokens[slot] = int(nxt[slot])
-            self._emit(req, int(nxt[slot]))
+        # chain on the newest in-flight step's device tokens (no host
+        # sync); otherwise the device token vector admissions write
+        # into; the host vector only on the cold first dispatch
+        if self._inflight:
+            cur = self._inflight[-1][0]
+        elif self._next_dev is not None:
+            cur = self._next_dev
+        else:
+            cur = self._next_tokens
+        nxt = self.engine.decode_active_async(cur, active, temps, sub)[0]
+        self._next_dev = nxt
+        self._inflight.append((nxt, {req.slot: req for req in self.running}))
+
+    def _drain_inflight(self) -> None:
+        """Read every pending first token and in-flight step (ONE
+        stacked device fetch) and do their host bookkeeping in
+        chronological order: firsts were queued at admission, before
+        any of the currently in-flight steps were dispatched.
+
+        Requests that finished or were preempted between dispatch and
+        drain have their tokens discarded (the dispatched steps computed
+        them anyway — their cache writes are overwritten before any
+        later attend can see them, the overwrite-before-attend
+        invariant).
+        """
+        if not self._inflight and not self._pending_first:
+            return
+        pending, self._inflight = self._inflight, []
+        firsts, self._pending_first = self._pending_first, []
+        parts = [f[3].reshape(1) for f in firsts] + \
+            [nxt.reshape(-1) for nxt, _ in pending]
+        vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
+            else np.asarray(parts[0])
+        nf = len(firsts)
+        S = self.engine.num_slots
+        for (req, gen, slot, _), tok in zip(firsts, vals[:nf]):
+            # stale if the request was cancelled or preempted (a
+            # readmission queues a fresh entry with a new generation)
+            if req.done or req.slot != slot or req.preemptions != gen:
+                continue
+            self._next_tokens[slot] = int(tok)
+            self._emit(req, int(tok))
+        rows = vals[nf:].reshape(len(pending), S) if pending else ()
+        for row, (_, snapshot) in zip(rows, pending):
+            for slot, req in snapshot.items():
+                if req.done or req.slot != slot:
+                    continue
+                self._next_tokens[slot] = int(row[slot])
+                self._emit(req, int(row[slot]))
 
     def _emit(self, req: Request, token: int) -> None:
         """Record one generated token; finish/stop bookkeeping."""

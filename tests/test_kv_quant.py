@@ -81,6 +81,23 @@ def test_engine_generate_unfused_matches_fused():
     assert np.array_equal(a.tokens, b.tokens)
 
 
+def test_float_cache_windowed_decode_token_parity():
+    """decode_window > 1 on the FLOAT cache (the knob, not the int8
+    default): windowed fused scan == per-step decode, ragged prompts
+    (exercises the vmapped ragged flush) and uniform prompts (the
+    single aliasable scalar-offset flush)."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(2))
+    sp = SamplingParams(max_new_tokens=10)
+    for prompts in ([[5, 7, 11, 2], [3, 1]],        # ragged flush path
+                    [[5, 7, 11], [2, 9, 4]]):       # uniform flush path
+        ref = InferenceEngine(model, params).generate(prompts, sp)
+        win = InferenceEngine(model, params,
+                              RuntimeConfig(decode_window=4)
+                              ).generate(prompts, sp)
+        assert np.array_equal(ref.tokens, win.tokens)
+
+
 def test_quant_cache_under_tp_mesh_matches_single_device():
     """int8 cache + TP/DP mesh: shard_cache handles the scale leaves and
     the sharded program matches the unmeshed int8 engine exactly."""

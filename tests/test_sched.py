@@ -160,6 +160,7 @@ def test_chunked_prefill_interleaves_decode():
     sched, params = make_sched(max_batch=2, max_seq=64, prefill_chunk=4)
     r1 = sched.submit([5, 7, 11], max_new_tokens=20)
     sched.tick()
+    sched.tick()  # second tick drains the first token + first decode step
     assert r1.state == "running" and len(r1.output) >= 1
     long_prompt = list(range(1, 17))  # 16 tokens = 4 chunks of 4
     r2 = sched.submit(long_prompt, max_new_tokens=4)
@@ -193,10 +194,31 @@ def test_cancel_mid_prefill_frees_resources():
 def test_decode_steps_per_tick():
     sched, params = make_sched(decode_steps_per_tick=3)
     req = sched.submit([5, 7, 11], max_new_tokens=10)
-    sched.tick()  # admission (first token) + 3 decode steps
+    # admission samples the first token on-device and the tick's 3
+    # decode steps are dispatched chained on it; everything drains in
+    # one stacked fetch at the NEXT tick's start (scheduler._inflight
+    # docs), so the host sees 1+3 tokens one tick later
+    sched.tick()
+    assert len(req.output) == 0
+    sched.tick()  # drains first + 3 in-flight steps, dispatches 3 more
     assert len(req.output) == 4
+    sched.tick()
+    assert len(req.output) == 7
     sched.run_until_done()
     assert req.output == ref_tokens(params, [5, 7, 11], 10)
+
+
+def test_request_sized_to_page_cap_completes():
+    """r5 regression: a request whose worst case exactly fills the
+    per-seq page cap (accepted by submit) must finish — the pipelined
+    page-growth target is clamped to the request's lifetime maximum,
+    otherwise it self-preempts forever chasing in-flight slack pages."""
+    sched, params = make_sched(max_batch=1, max_seq=32, page=8)
+    prompt = list(range(1, 25))  # 24 + 8 = 32 = max_pages_per_seq * page
+    req = sched.submit(prompt, max_new_tokens=8)
+    sched.run_until_done(max_ticks=200)
+    assert req.state == "finished"
+    assert req.output == ref_tokens(params, prompt, 8)
 
 
 def test_static_scheduler_drains_batches():
@@ -207,10 +229,9 @@ def test_static_scheduler_drains_batches():
     r2 = sched.submit([3, 1], max_new_tokens=6)
     sched.tick()
     r3 = sched.submit([9], max_new_tokens=2)
-    while r3.t_first_token is None:
-        assert r3.state == "waiting"
+    while r3.state == "waiting":
         sched.tick()
-    # r3 was only started after BOTH batch members finished
+    # r3 was only admitted after BOTH batch members finished
     assert r1.done and r2.done
     sched.run_until_done()
     assert r3.output == ref_tokens(params, [9], 2)

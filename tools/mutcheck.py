@@ -50,11 +50,18 @@ MUTANTS = [
      "scale = jnp.where(amax > 0, amax / 127.0, 1.0)",
      "scale = jnp.where(amax > 0, amax / 64.0, 1.0)",
      ["tests/test_kv_quant.py"], {}),
-    # decode window: attend one not-yet-written window slot
+    # decode window: window K-scales dropped from the merged softmax
+    # (quantized window scores would be raw code dots)
     ("butterfly_tpu/models/common.py",
-     "s_w = jnp.where(jnp.arange(C)[None, None, None, :] < wlen,",
-     "s_w = jnp.where(jnp.arange(C)[None, None, None, :] <= wlen,",
-     ["tests/test_kv_quant.py", "tests/test_engine.py"], {}),
+     "s_w = s_w * jnp.moveaxis(wk_s, 0, -1)[:, :, None, :]",
+     "s_w = s_w * 1.0",
+     ["tests/test_kv_quant.py"], {}),
+    # decode window flush (uniform fast path): off-by-one write offset —
+    # the flush group lands one slot late, orphaning slot `start`
+    ("butterfly_tpu/models/common.py",
+     "new_k = lax.dynamic_update_slice(cache.k, kq, (0, 0, 0, s0, 0))",
+     "new_k = lax.dynamic_update_slice(cache.k, kq, (0, 0, 0, s0 + 1, 0))",
+     ["tests/test_kv_quant.py"], {}),
     # prefix cache: chain digest forgets the parent (a page would match
     # regardless of what precedes it)
     ("butterfly_tpu/cache/prefix.py",
