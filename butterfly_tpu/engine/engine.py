@@ -88,10 +88,6 @@ class InferenceEngine:
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
         S = mesh.shape.get("stage", 1) if mesh is not None else 1
-        if self.runtime.kv_quant != "none" and S > 1:
-            raise NotImplementedError(
-                "kv_quant does not compose with pipeline stages yet "
-                "(the GPipe forward does not thread cache scales)")
         if virtual_stages > 1 and S > 1:
             # interleaved 1F1B-style schedule: permute the layer stack
             # once so each stage's contiguous shard holds its V
@@ -260,6 +256,88 @@ class InferenceEngine:
             self._cache_pool.popitem(last=False)  # FIFO-evict (frees HBM)
         return GenerateResult(tokens=out[:n_real], lengths=lens[:n_real],
                               prompt_lengths=np.asarray(true_lens)[:n_real])
+
+    def generate_long(self, prompt: Sequence[int],
+                      sp: Optional[SamplingParams] = None,
+                      seed: int = 0, impl: str = "ring") -> GenerateResult:
+        """Long-context generation over the mesh's `seq` axis (SURVEY §3
+        call stack 5): sequence-parallel prefill (parallel/sequence.py
+        sp_forward — ring attention or Ulysses) leaves the prompt's KV
+        sharded over `seq` where it was computed; decode steps
+        (sp_decode_step) merge per-device partial attention with
+        [B,Nq,H]-sized collectives, so the long prefix is never
+        regathered. Single sequence (the long-context shape); the prompt
+        is right-padded to a multiple of the seq axis and the pad K/V is
+        masked out of every decode step (prefill needs no mask: pads sit
+        at positions causality already excludes).
+
+        CLI surface: `butterfly generate --seq-parallel N`.
+        """
+        sp = sp or SamplingParams()
+        if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1:
+            raise ValueError(
+                "generate_long needs a mesh with a seq axis > 1 "
+                "(CLI: --seq-parallel N)")
+        if self.runtime.kv_quant != "none":
+            raise NotImplementedError(
+                "kv_quant does not compose with sequence parallelism yet "
+                "(sp_forward keeps the sharded prefix in the compute "
+                "dtype)")
+        from butterfly_tpu.models.common import init_cache
+        from butterfly_tpu.parallel.sequence import (sp_decode_step,
+                                                     sp_forward)
+
+        N = self.mesh.shape["seq"]
+        ids = list(prompt)
+        true_len = len(ids)
+        total = true_len + sp.max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({true_len}) + max_new_tokens "
+                f"({sp.max_new_tokens}) = {total} exceeds the model's "
+                f"max_seq_len ({self.cfg.max_seq_len})")
+        pad = -(-true_len // N) * N
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :true_len] = np.asarray(ids, np.int32)
+        plen = jnp.asarray([true_len], jnp.int32)
+
+        key, first_key, loop_key = jax.random.split(
+            jax.random.PRNGKey(seed), 3)
+        mesh = self.mesh
+        # jit wrappers cached per engine (keyed by impl): rebuilding them
+        # per call would re-trace and recompile both programs each time
+        if not hasattr(self, "_sp_programs"):
+            self._sp_programs = {}
+        if impl not in self._sp_programs:
+            self._sp_programs[impl] = (
+                jax.jit(lambda p, t: sp_forward(p, self.cfg, t, mesh,
+                                                impl=impl)),
+                jax.jit(lambda p, t, pos, pre, suf, pl: sp_decode_step(
+                    p, self.cfg, t, pos, pre, suf, mesh, prefix_len=pl)))
+        prefill, step = self._sp_programs[impl]
+        with self._mesh_ctx():
+            logits, prefix = prefill(self.params, jnp.asarray(tokens))
+            cur = sample(logits[:, true_len - 1, :], first_key, sp)
+            # replicated suffix cache sized for the whole decode run
+            suffix = init_cache(self.cfg, 1, sp.max_new_tokens)
+            out = [int(np.asarray(cur)[0])]
+            key = loop_key
+            while len(out) < sp.max_new_tokens and \
+                    not (sp.stop_token >= 0 and out[-1] == sp.stop_token):
+                positions = jnp.asarray([[true_len + len(out) - 1]],
+                                        jnp.int32)
+                logits, suffix = step(self.params, cur[:, None], positions,
+                                      prefix, suffix, plen)
+                key, sub = jax.random.split(key)
+                cur = sample(logits, sub, sp)
+                out.append(int(np.asarray(cur)[0]))
+
+        toks = np.asarray(out, np.int32)[None]
+        lens = _stop_lengths(toks, sp.stop_token)
+        return GenerateResult(tokens=_mask_after_stop(toks, lens,
+                                                      sp.stop_token),
+                              lengths=lens,
+                              prompt_lengths=np.asarray([true_len]))
 
     def generate_speculative(self, prompt: Sequence[int],
                              sp: Optional[SamplingParams] = None,

@@ -294,8 +294,24 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
     int8 cache: pass codes ck/cv [B,Kv,S,H] + scales k_s/v_s [B,Kv,S];
     the return gains the updated scales — (out, ck, cv, k_s, v_s)
     instead of (out, ck, cv).
+
+    ck is None (requires `fresh`): NO-CACHE mode for the fresh-prefill
+    fast path (_fresh_prefill_forward) — nothing is written, attention
+    runs over the just-projected K/V (flash, or a dense causal fallback
+    over the same values), and the raw k/v come back so the caller can
+    write the pools itself: returns (out, k, v).
     """
     q, k, v = qkv_proj(x, p, cfg, cos, sin)
+    if ck is None:
+        assert fresh, "no-cache attention_block is fresh-prefill only"
+        out = None
+        if cfg.attn_impl == "flash" and x.shape[1] > 1:
+            from butterfly_tpu.ops.flash_attention import (
+                flash_attention_sharded)
+            out = flash_attention_sharded(q, k, v, causal=True)
+        if out is None:
+            out = attend(q, k, v, mask, cfg)
+        return attn_output(out, p, cfg), k, v
     start = positions[:, 0]  # write offset per sequence
     if k_s is not None:  # int8 cache: write codes + scales
         ck, cv, k_s, v_s = update_cache_layer_q(ck, cv, k_s, v_s, k, v,
@@ -392,17 +408,17 @@ def transformer_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
                       v_s: Optional[jax.Array] = None):
     """Pre-norm residual block: x + attn(norm(x)); x + ffn(norm(x)).
 
-    Returns (x, ck, cv), or (x, ck, cv, k_s, v_s) with an int8 cache.
+    Returns (x, ck, cv), or (x, ck, cv, k_s, v_s) with an int8 cache;
+    in attention_block's no-cache fresh mode (ck None), (x, k, v) with
+    the layer's raw projected K/V.
     """
     h = pre_norm(x, lp["ln1"], cfg)
-    attn_out, ck, cv, *scales = attention_block(
+    attn_out, *rest = attention_block(
         h, lp["attn"], cfg, ck, cv, positions, mask, cos, sin, fresh,
         k_s, v_s)
     x = x + attn_out
     x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
-    if scales:
-        return (x, ck, cv, *scales)
-    return x, ck, cv
+    return (x, *rest)
 
 
 # ---------------------------------------------------------------------------
@@ -809,17 +825,10 @@ def _fresh_prefill_forward(params: Params, cfg: ModelConfig,
     def body(carry, lp):
         x, pools, i = carry
         lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
-        h = pre_norm(x, lp["ln1"], cfg)
-        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-        out = None
-        if cfg.attn_impl == "flash" and T > 1:
-            from butterfly_tpu.ops.flash_attention import (
-                flash_attention_sharded)
-            out = flash_attention_sharded(q, k, v, causal=True)
-        if out is None:
-            out = attend(q, k, v, mask, cfg)
-        x = x + attn_output(out, lp["attn"], cfg)
-        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        # no-cache layer body: same recipe as every other path, with the
+        # raw projected K/V returned for the pool write below
+        x, k, v = transformer_layer(x, lp, cfg, None, None, positions,
+                                    mask, cos, sin, fresh=True)
         ck, cv, cks, cvs = pools
         if quant:
             kq, ks = quantize_kv(k)

@@ -85,16 +85,21 @@ def pipeline_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
     mask = make_mask(positions, cache.max_seq)
 
+    quant = cache.quantized
+    stage_ops = (cache.k, cache.v)
+    if quant:  # scale leaves stage-shard their L dim like the code leaves
+        stage_ops += (cache.k_scale, cache.v_scale)
     if V > 1:
         body = partial(_interleaved_body, cfg=cfg, S=S, M=M, V=V,
-                       fresh=fresh)
+                       fresh=fresh, quant=quant)
     else:
-        body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh)
-    y, (new_k, new_v) = _run_gpipe(body, mesh, params["layers"],
-                                   (cache.k, cache.v),
-                                   (x, positions, mask, cos, sin), S, M, x)
+        body = partial(_pipeline_body, cfg=cfg, S=S, M=M, fresh=fresh,
+                       quant=quant)
+    y, new_cache = _run_gpipe(body, mesh, params["layers"], stage_ops,
+                              (x, positions, mask, cos, sin), S, M, x)
     logits = final_logits(params, cfg, y)
-    return logits, KVCache(new_k, new_v, cache.length + T)
+    return logits, KVCache(new_cache[0], new_cache[1], cache.length + T,
+                           *new_cache[2:])
 
 
 def interleave_layers(tree, num_layers: int, S: int, V: int,
@@ -300,9 +305,9 @@ def _paged_pipeline_body(layers, k_pages, v_pages, *ops, cfg: ModelConfig,
     return outs, kp, vp
 
 
-def _interleaved_body(layers, ck, cv, x, positions, mask, cos, sin,
-                      *, cfg: ModelConfig, S: int, M: int, V: int,
-                      fresh: bool = False):
+def _interleaved_body(layers, ck, cv, *ops, cfg: ModelConfig, S: int,
+                      M: int, V: int, fresh: bool = False,
+                      quant: bool = False):
     """Interleaved virtual-stage schedule (manual over stage).
 
     Work unit w = v*M + m: chunk v of microbatch m. Tick t has stage s
@@ -310,8 +315,14 @@ def _interleaved_body(layers, ck, cv, x, positions, mask, cos, sin,
     (S-1 -> 0): a microbatch leaving the last stage's chunk v re-enters
     stage 0 for chunk v+1. Early wrapped arrivals (they land after one
     hop but are consumed M-S+1 ticks later) sit in a per-microbatch
-    buffer on stage 0.
+    buffer on stage 0. int8 caches thread their scale leaves (leading
+    `ops`) through the same chunk/microbatch slicing as the code leaves.
     """
+    if quant:
+        ks, vs, x, positions, mask, cos, sin = ops
+    else:
+        x, positions, mask, cos, sin = ops
+        ks = vs = None
     B = x.shape[0]
     mb = B // M
     Lc = ck.shape[0] // V  # local layers per virtual chunk
@@ -323,8 +334,8 @@ def _interleaved_body(layers, ck, cv, x, positions, mask, cos, sin,
     sin_mb = sin.reshape(M, mb, *sin.shape[1:])
 
     layers_v = jax.tree.map(lambda a: a.reshape(V, Lc, *a.shape[1:]), layers)
-    ck_v = ck.reshape(V, Lc, *ck.shape[1:])
-    cv_v = cv.reshape(V, Lc, *cv.shape[1:])
+    cache_v = tuple(a.reshape(V, Lc, *a.shape[1:]) if a is not None else None
+                    for a in (ck, cv, ks, vs))
 
     stage = lax.axis_index("stage")
     state0 = jnp.zeros_like(xs[0])
@@ -333,7 +344,7 @@ def _interleaved_body(layers, ck, cv, x, positions, mask, cos, sin,
     ring = [(i, (i + 1) % S) for i in range(S)]
 
     def tick(c, t):
-        state, buf, ckv, cvv, outs = c
+        state, buf, cachev, outs = c
 
         # bank the state that just wrapped onto stage 0 (produced by the
         # last stage at t-1 with work index t-S; destined for chunk
@@ -356,31 +367,41 @@ def _interleaved_body(layers, ck, cv, x, positions, mask, cos, sin,
         lyr = jax.tree.map(
             lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
             layers_v)
-        ck_c = lax.dynamic_index_in_dim(ckv, v, 0, keepdims=False)
-        cv_c = lax.dynamic_index_in_dim(cvv, v, 0, keepdims=False)
-        ck_m = lax.dynamic_slice_in_dim(ck_c, m * mb, mb, axis=1)
-        cv_m = lax.dynamic_slice_in_dim(cv_c, m * mb, mb, axis=1)
+        chunk = tuple(
+            None if a is None
+            else lax.dynamic_index_in_dim(a, v, 0, keepdims=False)
+            for a in cachev)
+        mbs = tuple(
+            None if a is None
+            else lax.dynamic_slice_in_dim(a, m * mb, mb, axis=1)
+            for a in chunk)
 
-        y, nk, nv = scan_layers(lyr, cfg, inp, ck_m, cv_m,
-                                pos_mb[m], mask_mb[m], cos_mb[m],
-                                sin_mb[m], fresh)
+        y, *new = scan_layers(lyr, cfg, inp, mbs[0], mbs[1],
+                              pos_mb[m], mask_mb[m], cos_mb[m],
+                              sin_mb[m], fresh, mbs[2], mbs[3])
+        new = tuple(new) if quant else (*new, None, None)
 
-        nk = jnp.where(valid, nk, ck_m)
-        nv = jnp.where(valid, nv, cv_m)
-        ck_c = lax.dynamic_update_slice_in_dim(ck_c, nk, m * mb, axis=1)
-        cv_c = lax.dynamic_update_slice_in_dim(cv_c, nv, m * mb, axis=1)
-        ckv = lax.dynamic_update_index_in_dim(ckv, ck_c, v, 0)
-        cvv = lax.dynamic_update_index_in_dim(cvv, cv_c, v, 0)
+        def write_back(a_c, n, o):
+            return lax.dynamic_update_slice_in_dim(
+                a_c, jnp.where(valid, n, o), m * mb, axis=1)
+
+        chunk = tuple(None if a is None else write_back(a, n, o)
+                      for a, n, o in zip(chunk, new, mbs))
+        cachev = tuple(
+            None if a is None else lax.dynamic_update_index_in_dim(a, cc, v, 0)
+            for a, cc in zip(cachev, chunk))
 
         rec = jnp.where(valid & (stage == S - 1) & (v == V - 1), y, outs[m])
         outs = lax.dynamic_update_index_in_dim(outs, rec, m, 0)
         state = lax.ppermute(y, "stage", ring)
-        return (state, buf, ckv, cvv, outs), None
+        return (state, buf, cachev, outs), None
 
-    (_, _, ckv, cvv, outs), _ = lax.scan(
-        tick, (state0, buf0, ck_v, cv_v, out0),
+    (_, _, cachev, outs), _ = lax.scan(
+        tick, (state0, buf0, cache_v, out0),
         jnp.arange(V * M + S - 1))
-    return outs, ckv.reshape(ck.shape), cvv.reshape(cv.shape)
+    flat = tuple(a.reshape(o.shape) for a, o in
+                 zip(cachev, (ck, cv, ks, vs)) if a is not None)
+    return (outs, *flat)
 
 
 def _default_microbatches(B: int, S: int) -> int:
@@ -393,16 +414,21 @@ def _default_microbatches(B: int, S: int) -> int:
     return best
 
 
-def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
-                   *, cfg: ModelConfig, S: int, M: int,
-                   fresh: bool = False):
+def _pipeline_body(layers, ck, cv, *ops, cfg: ModelConfig, S: int, M: int,
+                   fresh: bool = False, quant: bool = False):
     """Per-stage GPipe body, contiguous cache (manual over stage).
 
-    layers/ck/cv are the local [L/S, ...] stage slice; x [B,T,D] etc. are
+    layers/ck/cv (and, for int8 caches, the two scale leaves that lead
+    `ops`) are the local [L/S, ...] stage slice; x [B,T,D] etc. are
     full-batch and replicated over stage. Returns outs stage-stacked
     (real results only on the last stage — out_specs P('stage'), caller
     slices — no [B,T,D] all-reduce over `stage`).
     """
+    if quant:
+        ks0, vs0, x, positions, mask, cos, sin = ops
+    else:
+        x, positions, mask, cos, sin = ops
+        ks0 = vs0 = None
     B = x.shape[0]
     mb = B // M
 
@@ -414,20 +440,28 @@ def _pipeline_body(layers, ck, cv, x, positions, mask, cos, sin,
     sin_mb = sin.reshape(M, mb, *sin.shape[1:])
 
     def step(carry, mc, valid, inp):
-        ck, cv = carry
-        ck_m = lax.dynamic_slice_in_dim(ck, mc * mb, mb, axis=1)
-        cv_m = lax.dynamic_slice_in_dim(cv, mc * mb, mb, axis=1)
+        ck, cv, ks, vs = carry
+        sl = lambda a: lax.dynamic_slice_in_dim(a, mc * mb, mb, axis=1)
+        ck_m, cv_m = sl(ck), sl(cv)
+        ks_m = sl(ks) if quant else None
+        vs_m = sl(vs) if quant else None
 
-        y, nk, nv = scan_layers(layers, cfg, inp, ck_m, cv_m,
-                                pos_mb[mc], mask_mb[mc], cos_mb[mc],
-                                sin_mb[mc], fresh)
+        y, nk, nv, *nsc = scan_layers(layers, cfg, inp, ck_m, cv_m,
+                                      pos_mb[mc], mask_mb[mc], cos_mb[mc],
+                                      sin_mb[mc], fresh, ks_m, vs_m)
 
         # write back cache only on valid (non-bubble) ticks
-        nk = jnp.where(valid, nk, ck_m)
-        nv = jnp.where(valid, nv, cv_m)
-        ck = lax.dynamic_update_slice_in_dim(ck, nk, mc * mb, axis=1)
-        cv = lax.dynamic_update_slice_in_dim(cv, nv, mc * mb, axis=1)
-        return y, (ck, cv)
+        upd = lambda a, n, o: lax.dynamic_update_slice_in_dim(
+            a, jnp.where(valid, n, o), mc * mb, axis=1)
+        ck = upd(ck, nk, ck_m)
+        cv = upd(cv, nv, cv_m)
+        if quant:
+            ks = upd(ks, nsc[0], ks_m)
+            vs = upd(vs, nsc[1], vs_m)
+        return y, (ck, cv, ks, vs)
 
-    outs, (ck, cv) = _gpipe_schedule(S, M, xs, step, (ck, cv))
+    outs, (ck, cv, ks, vs) = _gpipe_schedule(S, M, xs, step,
+                                             (ck, cv, ks0, vs0))
+    if quant:
+        return outs, ck, cv, ks, vs
     return outs, ck, cv

@@ -172,7 +172,9 @@ def sp_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
                    positions: jax.Array, prefix: KVCache, suffix: KVCache,
-                   mesh: Mesh) -> Tuple[jax.Array, KVCache]:
+                   mesh: Mesh,
+                   prefix_len: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, KVCache]:
     """One decode step consuming sp_forward's sequence-sharded cache.
 
     The long prefix stays sharded over `seq` exactly where prefill left it
@@ -186,6 +188,11 @@ def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     tokens/positions: [B,1] (positions = prefix length + step).
     Returns (last-token logits [B,V], suffix cache with the new K/V).
 
+    prefix_len [B]: number of REAL prefix tokens per row; prefix slots at
+    or past it are masked out. Defaults to prefix.length (no padding).
+    generate_long pads prompts up to a multiple of the seq axis, so the
+    tail of the sharded prefix holds pad K/V that must not be attended.
+
     Capacity contract (as for the paged pool, where the host allocator
     guarantees pages): the caller must size the suffix cache for the
     whole decode run — a step past suffix.max_seq would clamp its write
@@ -196,6 +203,8 @@ def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
             raise ValueError(
                 f"suffix cache full ({suffix.max_seq} slots): size "
                 "init_cache(max_seq=...) for the whole decode run")
+    if prefix_len is None:
+        prefix_len = prefix.length
     body = partial(_sp_decode_body, cfg=cfg)
     layer_in = jax.tree.map(lambda _: P(), params["layers"])
     head = {k: v for k, v in params.items() if k != "layers"}
@@ -204,17 +213,17 @@ def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(layer_in, head_in, P(), P(), seq_kv, seq_kv,
-                  P(), P(), P()),
+                  P(), P(), P(), P()),
         out_specs=(P(), P(), P()),
         axis_names={"seq"}, check_vma=False)
     logits, new_sk, new_sv = fn(params["layers"], head, tokens, positions,
                                 prefix.k, prefix.v, suffix.k, suffix.v,
-                                suffix.length)
+                                suffix.length, prefix_len)
     return logits, KVCache(new_sk, new_sv, suffix.length + 1)
 
 
 def _sp_decode_body(layers, head, tokens, positions, pk, pv, sck, scv, slen,
-                    *, cfg: ModelConfig):
+                    plen, *, cfg: ModelConfig):
     """Per-device decode step (inside shard_map, manual over seq)."""
     from butterfly_tpu.models.common import update_cache_layer
 
@@ -231,6 +240,13 @@ def _sp_decode_body(layers, head, tokens, positions, pk, pv, sck, scv, slen,
     # query by construction, so the prefix needs no mask at all.
     j = jnp.arange(Smax)
     suf_mask = j[None, :] <= slen[:, None]                   # [B,Smax]
+    # local prefix-chunk mask: global slot index < the row's REAL prefix
+    # length (pad K/V past it — generate_long's divisibility padding —
+    # must contribute nothing)
+    idx = lax.axis_index("seq")
+    Tl = pk.shape[2]
+    gpos = idx * Tl + jnp.arange(Tl)                         # [Tl] global
+    pre_mask = gpos[None, :] < plen[:, None]                 # [B,Tl]
 
     def layer(x, scanned):
         lp, pkl, pvl, ck, cv = scanned
@@ -244,8 +260,10 @@ def _sp_decode_body(layers, head, tokens, positions, pk, pv, sck, scv, slen,
         # local prefix chunk -> partial online-softmax accumulators
         s_p = jnp.einsum("btkgh,bskh->bktgs", qg, pkl,
                          preferred_element_type=jnp.float32) * scale
+        s_p = jnp.where(pre_mask[:, None, None, None, :], s_p, NEG)
         m_i = jnp.max(s_p, axis=-1)                          # [B,Kv,1,G]
         p_i = jnp.exp(s_p - m_i[..., None])
+        p_i = jnp.where(s_p <= NEG, 0.0, p_i)
         l_i = jnp.sum(p_i, axis=-1)
         acc_i = jnp.einsum("bktgs,bskh->bktgh", p_i,
                            pvl.astype(jnp.float32))

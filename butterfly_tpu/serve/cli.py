@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--stage-parallel", type=int, default=1)
         sp.add_argument("--expert-parallel", type=int, default=1)
         sp.add_argument("--data-parallel", type=int, default=1)
+        sp.add_argument("--seq-parallel", type=int, default=1,
+                        help="sequence/context parallelism: shard the "
+                             "prompt over N devices (ring attention; "
+                             "the long-context path — prefix KV stays "
+                             "sharded where it was computed)")
         sp.add_argument("--max-seq", type=int, default=2048)
         sp.add_argument("--dcn-axes", default="data",
                         help="comma list of mesh axes to place ACROSS TPU "
@@ -135,7 +140,8 @@ def build_mesh(args):
     pp = getattr(args, "stage_parallel", 1)
     ep = getattr(args, "expert_parallel", 1)
     dp = getattr(args, "data_parallel", 1)
-    n = tp * pp * ep * dp
+    sq = getattr(args, "seq_parallel", 1)
+    n = tp * pp * ep * dp * sq
     if n == 1:
         return None
     init_distributed()
@@ -143,9 +149,10 @@ def build_mesh(args):
     if n > ndev:
         raise SystemExit(
             f"error: --tensor-parallel {tp} x --stage-parallel {pp} x "
-            f"--expert-parallel {ep} x --data-parallel {dp} = {n} devices, "
+            f"--expert-parallel {ep} x --data-parallel {dp} x "
+            f"--seq-parallel {sq} = {n} devices, "
             f"but only {ndev} are available")
-    cfg = MeshConfig(data=dp, stage=pp, expert=ep, tensor=tp)
+    cfg = MeshConfig(data=dp, stage=pp, expert=ep, seq=sq, tensor=tp)
     # hybrid: on a multi-slice job the --dcn-axes span slices over DCN
     # and every per-layer collective stays on ICI; single-slice device
     # sets (and CPU) fall back to the plain mesh inside
@@ -192,6 +199,25 @@ def cmd_generate(args) -> int:
               f"vocab ({vocab}); pass a matching --tokenizer", file=sys.stderr)
         return 2
     t0 = time.perf_counter()
+    if args.seq_parallel > 1:
+        if args.speculate > 0:
+            print("error: --speculate does not compose with "
+                  "--seq-parallel (the long-context path has no warm "
+                  "multi-token verify)", file=sys.stderr)
+            return 2
+        if args.kv_quant != "none":
+            print("error: --kv-quant does not compose with "
+                  "--seq-parallel yet", file=sys.stderr)
+            return 2
+        # long-context path: sp_forward prefill + sp_decode_step loop
+        # (engine.generate_long docs)
+        res = engine.generate_long(ids, sp, seed=args.seed)
+        dt = time.perf_counter() - t0
+        n = int(res.lengths[0])
+        print(tok.decode(res.tokens[0, :n].tolist()))
+        print(f"[butterfly] {n} tokens in {dt:.2f}s over "
+              f"{args.seq_parallel}-way sequence parallelism", file=sys.stderr)
+        return 0
     if args.speculate > 0:
         if args.temperature > 0:
             print("error: --speculate requires greedy decoding "
@@ -221,11 +247,20 @@ def cmd_generate(args) -> int:
 
 
 def cmd_serve(args) -> int:
+    if args.seq_parallel > 1:
+        print("error: --seq-parallel applies to `generate` (long-context "
+              "single-sequence path); the serving engine shards slots over "
+              "data/tensor/stage instead", file=sys.stderr)
+        return 2
     from butterfly_tpu.serve.server import run_server
     return run_server(args)
 
 
 def cmd_bench(args) -> int:
+    if args.seq_parallel > 1:
+        print("error: --seq-parallel applies to `generate` (long-context "
+              "single-sequence path)", file=sys.stderr)
+        return 2
     from butterfly_tpu.obs.benchmark import run_decode_benchmark
 
     model = resolve_model(args)
