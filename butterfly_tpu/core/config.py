@@ -202,6 +202,13 @@ class RuntimeConfig:
                                       # writes; 0 = auto (16 with an
                                       # int8 cache — measured best on
                                       # v5e — else 1)
+    speculative_gamma: int = 0        # serving-path prompt-lookup
+                                      # speculative decoding: draft this
+                                      # many tokens per slot and verify
+                                      # them in ONE batched forward.
+                                      # Greedy-only (submit rejects
+                                      # temperature > 0). 0 = off
+    speculative_ngram: int = 2        # lookup ngram for the drafts
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

@@ -47,6 +47,20 @@ class SpeculativeResult:
         return len(self.tokens) / max(1, self.forwards)
 
 
+def _accept_drafts(draft, greedy) -> List[int]:
+    """Greedy draft acceptance shared by generate_speculative and the
+    serving scheduler's _spec_step (their semantics must not drift):
+    emit greedy[0] (the token after `cur`), then keep accepting while
+    draft[i] == greedy[i], each acceptance also emitting greedy[i+1].
+    Token-for-token identical to plain greedy decode by construction."""
+    emitted = [int(greedy[0])]
+    for i, d in enumerate(draft):
+        if d != int(greedy[i]):
+            break
+        emitted.append(int(greedy[i + 1]))
+    return emitted
+
+
 def _ngram_draft(history, gamma: int, ngram: int):
     """Prompt-lookup draft: find the most recent earlier occurrence of
     the trailing `ngram` tokens and propose what followed it. Pads with
@@ -410,11 +424,7 @@ class InferenceEngine:
             greedy = np.asarray(greedy[0])  # [gamma+1]
             forwards += 1
 
-            emitted = [int(greedy[0])]
-            for i in range(gamma):
-                if draft[i] != int(greedy[i]):
-                    break
-                emitted.append(int(greedy[i + 1]))
+            emitted = _accept_drafts(draft, greedy)
             accepted_total += len(emitted) - 1
             # valid cache entries: cur + the accepted drafts
             new_len = pos0 + len(emitted)

@@ -123,6 +123,9 @@ class ServingEngine:
         self._decode = jax.jit(
             partial(_decode_all, self.cfg, fwd, use_kernel=use_kernels),
             static_argnums=(5, 6), donate_argnums=(2,))
+        # batched multi-token greedy verify (scheduler speculative mode)
+        self._verify = jax.jit(
+            partial(_verify_all, self.cfg, fwd), donate_argnums=(2,))
 
     def _mesh_ctx(self):
         import contextlib
@@ -223,6 +226,33 @@ class ServingEngine:
         self.cache = cache
         return nxt, logits
 
+    def verify_active(self, tokens: np.ndarray,
+                      active: np.ndarray) -> np.ndarray:
+        """Batched (gamma+1)-token greedy verify for every slot
+        (sched/scheduler.py speculative mode): one warm forward over
+        [S, C] draft chunks at each slot's current length, writing ALL
+        positions' K/V. Returns the per-position greedy next tokens
+        [S, C]. Rejected positions leave stale K/V that the next
+        verify/decode rewrites before any query can attend that far
+        (write-then-attend — engine.generate_speculative docs); the
+        scheduler rolls device lengths back to the accepted counts via
+        fix_lengths."""
+        self._sync_table()
+        with self._mesh_ctx():
+            greedy, cache = self._verify(self.params, jnp.asarray(tokens),
+                                         self.cache, jnp.asarray(active))
+        self.cache = cache
+        return np.asarray(greedy)
+
+    def fix_lengths(self, mask: np.ndarray, values: np.ndarray) -> None:
+        """lengths[slot] = values[slot] where mask — the speculative
+        accept rollback (verify advanced every active slot by the full
+        draft length)."""
+        with self._mesh_ctx():
+            self.cache = self.cache._replace(
+                lengths=jnp.where(jnp.asarray(mask), jnp.asarray(values),
+                                  self.cache.lengths))
+
     # static sampling knobs (per-slot temps are dynamic)
     @property
     def runtime_top_k(self) -> int:
@@ -267,3 +297,14 @@ def _decode_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,
     last = logits[:, -1, :]
     nxt = sample_batched(last, key, temps, top_k, top_p)
     return nxt, last, cache
+
+
+def _verify_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,
+                active):
+    """[S, C] draft chunks -> per-position greedy next tokens [S, C].
+
+    One warm multi-token paged forward (T = C = gamma+1): the dense
+    gather-attention path with the absolute-position causal mask — the
+    same program shape as a chunked warm prefill."""
+    logits, cache = fwd(params, cfg, tokens, cache, active=active)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache

@@ -125,6 +125,7 @@ class Scheduler:
         self._metrics: Dict[str, float] = {
             "requests_total": 0, "requests_finished": 0,
             "tokens_generated_total": 0, "preemptions_total": 0,
+            "spec_forwards_total": 0, "spec_drafts_accepted_total": 0,
         }
         # latency reservoirs: both bounded to the same recent window so
         # the two adjacent metrics share time-horizon semantics (and a
@@ -149,6 +150,11 @@ class Scheduler:
                 f"request needs {worst} KV pages (prompt {len(prompt)} + "
                 f"max_new {max_new_tokens}) but the limit is "
                 f"{min(self.alloc.max_pages_per_seq, self.alloc.num_pages)}")
+        if self.engine.runtime.speculative_gamma > 0 and temperature > 0:
+            raise ValueError(
+                "speculative serving is greedy-only (stochastic drafts "
+                "would need the rejection-sampling correction): submit "
+                "with temperature=0 or disable speculative_gamma")
         req = Request(id=next(self._ids), prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       stop_token=stop_token, on_token=on_token,
@@ -227,9 +233,10 @@ class Scheduler:
         # in-flight step would race the table sync
         self._drain_inflight()
         self._admit()
+        spec = self.engine.runtime.speculative_gamma > 0
         for _ in range(max(1, self.engine.runtime.decode_steps_per_tick)):
             if self.running:
-                self._decode_step()
+                self._spec_step() if spec else self._decode_step()
         return int(self._metrics["tokens_generated_total"] - before)
 
     def metrics(self) -> Dict[str, float]:
@@ -365,6 +372,64 @@ class Scheduler:
         nxt = self.engine.decode_active_async(cur, active, temps, sub)[0]
         self._next_dev = nxt
         self._inflight.append((nxt, {req.slot: req for req in self.running}))
+
+    def _spec_step(self) -> None:
+        """One speculative round: per-slot prompt-lookup drafts, ONE
+        batched (gamma+1)-token verify forward, host accept loop.
+
+        Token-for-token identical to plain greedy decode (the engine
+        generate_speculative contract, batched across slots): drafts
+        only change how many forwards the tokens take. The verify
+        advances every active slot's device length by the full draft
+        width; fix_lengths rolls each back to its accepted count.
+        Synchronous (no in-flight chain): the next round's drafts need
+        this round's tokens on the host.
+        """
+        from butterfly_tpu.engine.engine import _accept_drafts, _ngram_draft
+        rt = self.engine.runtime
+        gamma, ngram = rt.speculative_gamma, rt.speculative_ngram
+        C = gamma + 1
+        self._drain_inflight()  # drafts need every host-visible token
+        for req in list(self.running):
+            if req in self.running:
+                need = min(len(req.all_tokens) + C,
+                           len(req.prompt) + req.max_new_tokens)
+                self._ensure_or_preempt(req, need)
+        if not self.running:
+            return
+
+        S = self.engine.num_slots
+        toks = np.zeros((S, C), np.int32)
+        active = np.zeros((S,), bool)
+        drafts: Dict[int, List[int]] = {}
+        for req in self.running:
+            d = _ngram_draft(req.all_tokens, gamma, ngram)
+            toks[req.slot, 0] = req.all_tokens[-1]
+            toks[req.slot, 1:] = d
+            drafts[req.slot] = d
+            active[req.slot] = True
+        greedy = self.engine.verify_active(toks, active)
+        self._metrics["spec_forwards_total"] += 1
+
+        mask = np.zeros((S,), bool)
+        vals = np.zeros((S,), np.int32)
+        for req in list(self.running):
+            slot = req.slot
+            emitted = _accept_drafts(drafts[slot], greedy[slot])
+            n_before = len(req.output)
+            for t in emitted:
+                self._emit(req, t)
+                if req.done:
+                    break
+            # count only drafts actually EMITTED (stop/max_new may
+            # truncate mid-group); the first token isn't a draft
+            self._metrics["spec_drafts_accepted_total"] += max(
+                0, len(req.output) - n_before - 1)
+            if req.slot is not None:  # still running: roll length back
+                mask[slot] = True
+                vals[slot] = len(req.all_tokens) - 1
+                self._next_tokens[slot] = req.output[-1]
+        self.engine.fix_lengths(mask, vals)
 
     def _drain_inflight(self) -> None:
         """Read every pending first token and in-flight step (ONE

@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reuse KV pages across requests sharing a prompt "
                         "prefix (content-hashed, refcounted; cuts TTFT for "
                         "shared system prompts)")
+    s.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
+                   help="serving-path prompt-lookup speculative decoding: "
+                        "draft GAMMA tokens per slot, verify all slots in "
+                        "one batched forward (greedy-only: requests with "
+                        "temperature > 0 are rejected)")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)

@@ -641,7 +641,8 @@ def run_server(args) -> int:
                        top_k=args.top_k, top_p=args.top_p,
                        max_queue=args.max_queue,
                        prefix_caching=getattr(args, "prefix_caching", False),
-                       kv_quant=getattr(args, "kv_quant", "none"))
+                       kv_quant=getattr(args, "kv_quant", "none"),
+                       speculative_gamma=getattr(args, "speculate", 0))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     sched = Scheduler(engine)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk

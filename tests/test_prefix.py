@@ -276,3 +276,36 @@ def test_parity_under_preemption_pressure():
         s.run_until_done()
     for rp, rc in zip(plain._reqs, cached._reqs):
         assert rp.output == rc.output
+
+
+def test_prefix_caching_on_data_tensor_mesh():
+    """VERDICT r4 item 9: shared prefix pages + data/tensor-sharded pools
+    and block tables compose — a cache-hitting admission on the meshed
+    engine is token-exact with cold admission on the unmeshed one."""
+    import jax
+    import pytest
+    from butterfly_tpu.core.config import MeshConfig
+    from butterfly_tpu.core.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake devices")
+    cfg = tiny("llama", dtype="float32", param_dtype="float32",
+               num_heads=8, num_kv_heads=4, head_dim=8)
+    model = Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rt = RuntimeConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                       prefix_caching=True)
+
+    plain = Scheduler(ServingEngine(model, params, rt.replace(
+        prefix_caching=False)))
+    want = [run_one(plain, p) for p in (PROMPT, PROMPT, PROMPT[:9])]
+
+    mesh = make_mesh(MeshConfig(data=2, tensor=4))
+    s = Scheduler(ServingEngine(model, params, rt, mesh=mesh))
+    got = [run_one(s, p) for p in (PROMPT, PROMPT, PROMPT[:9])]
+    assert got == want
+    # the repeat admission (and the shorter shared prefix) actually hit
+    assert s.alloc.hit_tokens >= 16
+    spec = s.engine.cache.k_pages.sharding.spec
+    assert spec[2] == "tensor"  # pools really are sharded under the mesh
+    assert s.engine.cache.page_table.sharding.spec[0] == "data"

@@ -260,3 +260,62 @@ def test_inter_token_latency_metrics():
     assert m["itl_p50"] >= 0 and m["itl_max"] >= m["itl_p50"]
     # gaps = (6-1) + (4-1)
     assert len(sched._itls) == (len(r1.output) - 1) + (len(r2.output) - 1)
+
+
+def test_speculative_scheduler_greedy_parity():
+    """VERDICT r4 item 7: scheduler-level speculative decoding — per-slot
+    ngram drafts + one batched verify — is token-for-token identical to
+    the plain scheduler, across slots with different prompts/lengths."""
+    sched, params = make_sched(max_batch=4, max_seq=64,
+                               speculative_gamma=3)
+    ref, _ = make_sched(max_batch=4, max_seq=64)
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
+    want = [ref.submit(p, max_new_tokens=12) for p in prompts]
+    ref.run_until_done()
+    got = [sched.submit(p, max_new_tokens=12) for p in prompts]
+    sched.run_until_done()
+    assert [r.output for r in got] == [r.output for r in want]
+    assert sched.metrics()["spec_forwards_total"] > 0
+
+
+def test_speculative_scheduler_accepts_drafts():
+    """On a looping continuation (prompt seeded with the model's own
+    greedy output), drafts must hit: fewer verify forwards than tokens."""
+    ref, params = make_sched(max_batch=2, max_seq=128)
+    r0 = ref.submit([5, 7, 11], max_new_tokens=24)
+    ref.run_until_done()
+    prompt = [5, 7, 11] + r0.output
+
+    ref2, _ = make_sched(max_batch=2, max_seq=128)
+    want = ref2.submit(prompt, max_new_tokens=16)
+    ref2.run_until_done()
+
+    sched, _ = make_sched(max_batch=2, max_seq=128, speculative_gamma=4)
+    got = sched.submit(prompt, max_new_tokens=16)
+    sched.run_until_done()
+    assert got.output == want.output
+    m = sched.metrics()
+    assert m["spec_drafts_accepted_total"] > 0
+    # >1 tokens per verify forward on the repetitive continuation
+    assert m["tokens_generated_total"] > m["spec_forwards_total"]
+
+
+def test_speculative_scheduler_rejects_sampling():
+    import pytest
+    sched, _ = make_sched(speculative_gamma=2)
+    with pytest.raises(ValueError, match="greedy-only"):
+        sched.submit([5, 7], max_new_tokens=4, temperature=0.7)
+
+
+def test_speculative_scheduler_stop_token():
+    ref, _ = make_sched(max_batch=2, max_seq=64)
+    base = ref.submit([5, 7, 11], max_new_tokens=12)
+    ref.run_until_done()
+    stop = base.output[6]
+    ref2, _ = make_sched(max_batch=2, max_seq=64)
+    want = ref2.submit([5, 7, 11], max_new_tokens=12, stop_token=stop)
+    ref2.run_until_done()
+    sched, _ = make_sched(max_batch=2, max_seq=64, speculative_gamma=3)
+    got = sched.submit([5, 7, 11], max_new_tokens=12, stop_token=stop)
+    sched.run_until_done()
+    assert got.output == want.output

@@ -85,11 +85,12 @@ MUTANTS = [
      "out = self.text[self.released:cut]",
      "out = self.text[self.released:cut + 1]",
      ["tests/test_server.py"], {}),
-    # speculative decoding: accept mismatched drafts
+    # speculative decoding: accept mismatched drafts (the ONE shared
+    # accept loop — engine generate_speculative AND scheduler _spec_step)
     ("butterfly_tpu/engine/engine.py",
-     "if draft[i] != int(greedy[i]):",
-     "if False and draft[i] != int(greedy[i]):",
-     ["tests/test_speculative.py"], {}),
+     "if d != int(greedy[i]):",
+     "if False and d != int(greedy[i]):",
+     ["tests/test_speculative.py", "tests/test_sched.py"], {}),
     # allocator: hand out one page fewer than needed. Must pin the
     # PYTHON backend: with the native lib built, the scheduler uses the
     # C++ twin and a Python-side mutation is invisible (first mutcheck
@@ -108,6 +109,46 @@ MUTANTS = [
      "offset = pos % page",
      "offset = pos * 0",
      ["tests/test_paged.py"], {}),
+    # paged decode kernel: attend one not-yet-written slot past each
+    # sequence's length
+    ("butterfly_tpu/ops/paged_attention.py",
+     "mask = group_ok & (pos < length)",
+     "mask = group_ok & (pos <= length)",
+     ["tests/test_kernels.py"], {}),
+    # paged decode kernel: K scales dropped (int8 scores = raw code dots)
+    ("butterfly_tpu/ops/paged_attention.py",
+     "s = s * ks_ref[0]",
+     "s = s * 1.0",
+     ["tests/test_kernels.py"], {}),
+    # contiguous int8 attend: V scale not folded into the probs
+    ("butterfly_tpu/models/common.py",
+     "probs = probs * v_scale[:, :, None, None, :]",
+     "probs = probs * 1.0",
+     ["tests/test_kv_quant.py"], {}),
+    # ring attention: one rotation short (each device misses one
+    # neighbor's K/V block)
+    ("butterfly_tpu/parallel/sequence.py",
+     "step, (m, l, acc, k, v, k_pos), None, length=N)",
+     "step, (m, l, acc, k, v, k_pos), None, length=N - 1)",
+     ["tests/test_sequence.py"], {}),
+    # sp_decode partial-softmax merge: global max skipped (per-device
+    # exp shifts disagree, denominators mis-merge)
+    ("butterfly_tpu/parallel/sequence.py",
+     'm_g = lax.pmax(m_i, "seq")',
+     "m_g = m_i",
+     ["tests/test_sequence.py"], {}),
+    # EP a2a dispatch: counting-sort slot ignores the running count
+    # (every assignment of an expert lands in slot 0)
+    ("butterfly_tpu/parallel/expert.py",
+     "pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(A), g_flat]",
+     "pos = 0 * (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(A), g_flat]",
+     ["tests/test_expert.py"], {}),
+    # speculative scheduler: length rollback off by one (the first
+    # rejected position's stale K/V becomes attendable)
+    ("butterfly_tpu/sched/scheduler.py",
+     "vals[slot] = len(req.all_tokens) - 1",
+     "vals[slot] = len(req.all_tokens)",
+     ["tests/test_sched.py"], {}),
 ]
 
 
