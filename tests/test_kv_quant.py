@@ -81,6 +81,31 @@ def test_engine_generate_unfused_matches_fused():
     assert np.array_equal(a.tokens, b.tokens)
 
 
+def test_int8_windowed_multi_flush_group_parity():
+    """Uniform prompts + int8 cache + a window SMALLER than max_new: the
+    second and third flush groups must attend K/V the earlier groups
+    flushed — pins the uniform-flush write offset (a one-slot-late
+    flush survives any single-group test: nothing ever reads it)."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(2))
+    # sharpen attention: 0.02-std random weights give near-uniform
+    # softmax (q.k ~ 0), which hides key-side cache corruption from
+    # greedy argmax entirely — scale wq/wk so scores are O(1) and a
+    # misplaced key actually changes what each step attends
+    attn = params["layers"]["attn"]
+    attn["wq"] = attn["wq"] * 8.0
+    attn["wk"] = attn["wk"] * 8.0
+    sp = SamplingParams(max_new_tokens=32)
+    prompts = [[5, 7, 11], [2, 9, 4]]   # equal lengths -> uniform flush
+    ref = InferenceEngine(model, params,
+                          RuntimeConfig(kv_quant="int8", decode_window=1)
+                          ).generate(prompts, sp)
+    win = InferenceEngine(model, params,
+                          RuntimeConfig(kv_quant="int8", decode_window=4)
+                          ).generate(prompts, sp)
+    assert np.array_equal(ref.tokens, win.tokens)
+
+
 def test_float_cache_windowed_decode_token_parity():
     """decode_window > 1 on the FLOAT cache (the knob, not the int8
     default): windowed fused scan == per-step decode, ragged prompts
