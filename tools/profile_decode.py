@@ -25,6 +25,8 @@ def main() -> int:
     ap.add_argument("--max-new", type=int, default=128)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--preset", default="1b", choices=("1b", "8b"),
+                    help="'1b' (round-4 proxy) or '8b' (config of record)")
     ap.add_argument("--out", default=None, help="trace dir (default: tmp)")
     ap.add_argument("--top", type=int, default=15)
     args = ap.parse_args()
@@ -38,10 +40,14 @@ def main() -> int:
     from butterfly_tpu.engine.engine import pad_prompts
     from butterfly_tpu.engine.sampling import sample
     from butterfly_tpu.models.common import Model
-    from butterfly_tpu.quant.int8 import quantize_int8
+    from butterfly_tpu.quant.int8 import (init_params_quantized,
+                                          quantize_int8)
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    if on_tpu:
+    if on_tpu and args.preset == "8b":
+        from butterfly_tpu.core.config import llama3_8b
+        cfg = llama3_8b().replace(max_seq_len=2048)
+    elif on_tpu:
         cfg = ModelConfig(arch="llama", vocab_size=32000, hidden_size=2048,
                           num_layers=16, num_heads=16, num_kv_heads=8,
                           head_dim=128, intermediate_size=5632,
@@ -51,7 +57,8 @@ def main() -> int:
         args.batch, args.prompt_len, args.max_new = 4, 32, 16
 
     model = Model(cfg)
-    params = quantize_int8(model.init(jax.random.PRNGKey(0)), cfg)
+    params = init_params_quantized(cfg, jax.random.PRNGKey(0)) if on_tpu \
+        else quantize_int8(model.init(jax.random.PRNGKey(0)), cfg)
     kv_quant = "int8" if on_tpu else "none"
     engine = InferenceEngine(
         model, params,
