@@ -90,6 +90,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "draft GAMMA tokens per slot, verify all slots in "
                         "one batched forward (greedy-only: requests with "
                         "temperature > 0 are rejected)")
+    def positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+        return n
+
+    s.add_argument("--decode-steps-per-tick", type=positive_int, default=1,
+                   help="decode steps chained device-side per scheduler "
+                        "tick; the host drains their tokens in ONE "
+                        "stacked fetch. Raise on high host<->device "
+                        "latency setups (tokens then surface in bursts "
+                        "of this size). NB: with --speculate the verify "
+                        "rounds are host-synchronous, so the chaining "
+                        "benefit applies to plain decoding only")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)

@@ -642,7 +642,9 @@ def run_server(args) -> int:
                        max_queue=args.max_queue,
                        prefix_caching=getattr(args, "prefix_caching", False),
                        kv_quant=getattr(args, "kv_quant", "none"),
-                       speculative_gamma=getattr(args, "speculate", 0))
+                       speculative_gamma=getattr(args, "speculate", 0),
+                       decode_steps_per_tick=getattr(
+                           args, "decode_steps_per_tick", 1))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     sched = Scheduler(engine)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk

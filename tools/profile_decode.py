@@ -53,6 +53,9 @@ def main() -> int:
                           head_dim=128, intermediate_size=5632,
                           max_seq_len=2048)
     else:
+        if args.preset != "1b":
+            print(f"warning: no TPU visible — profiling the tiny CPU "
+                  f"config, NOT --preset {args.preset}", file=sys.stderr)
         cfg = tiny("llama", dtype="float32", param_dtype="float32")
         args.batch, args.prompt_len, args.max_new = 4, 32, 16
 
