@@ -197,7 +197,8 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
         "serving_kv_quant": kv_quant,
         "serving_preemptions": m["preemptions_total"],
     }
-    for k in ("ttft_p50", "ttft_p95", "itl_p50", "itl_p95"):
+    for k in ("ttft_p50", "ttft_p95", "itl_p50", "itl_p95",
+              "itl_req_mean_p50", "itl_req_mean_p95"):
         if k in m:
             out[k] = m[k]
     return out

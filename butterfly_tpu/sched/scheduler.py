@@ -134,8 +134,13 @@ class Scheduler:
         # inter-token gaps (seconds), bounded reservoir of the most
         # recent gaps across all requests — the latency a decoding
         # request experiences when admissions interleave (the quantity
-        # chunked prefill exists to bound)
+        # chunked prefill exists to bound). With pipelined dispatch,
+        # tokens surface in per-tick bursts, so raw gap percentiles
+        # bimodalize (p50 ~ 0, p95 ~ tick); _itl_means tracks each
+        # finished request's MEAN gap (t_last - t_first)/(n - 1) — the
+        # effective per-token rate a streaming client experiences.
         self._itls: Deque[float] = deque(maxlen=4096)
+        self._itl_means: Deque[float] = deque(maxlen=4096)
 
     # -- public API ---------------------------------------------------------
 
@@ -257,6 +262,10 @@ class Scheduler:
             m["itl_p50"] = float(np.percentile(a, 50))
             m["itl_p95"] = float(np.percentile(a, 95))
             m["itl_max"] = float(a.max())
+        if self._itl_means:
+            a = np.asarray(self._itl_means)
+            m["itl_req_mean_p50"] = float(np.percentile(a, 50))
+            m["itl_req_mean_p95"] = float(np.percentile(a, 95))
         return m
 
     # -- internals ----------------------------------------------------------
@@ -486,6 +495,11 @@ class Scheduler:
             self._finish(req)
 
     def _finish(self, req: Request, state: str = "finished") -> None:
+        if state == "finished" and len(req.output) > 1 and \
+                req.t_first_token is not None:
+            self._itl_means.append(
+                (req.t_last_token - req.t_first_token)
+                / (len(req.output) - 1))
         if req.slot is not None:
             # publish the written tokens' full pages before releasing
             # (the latest sampled token's K/V is never written — it

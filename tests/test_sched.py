@@ -104,6 +104,8 @@ def test_metrics_surface():
     assert m["requests_finished"] == 1
     assert m["tokens_generated_total"] == 2
     assert m["ttft_p50"] >= 0
+    # per-request mean inter-token gap: the burst-robust ITL stat
+    assert m["itl_req_mean_p50"] >= 0
     assert m["kv_pages_free"] == m["kv_pages_total"]
 
 
