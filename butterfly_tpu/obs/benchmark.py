@@ -125,11 +125,12 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     the paged pool (int8 codes by default) and the Pallas paged-attention
     kernel, under staggered arrivals.
 
-    The arrival rate is calibrated from a measured decode tick so offered
-    load is `utilization` x the engine's decode capacity — TTFT/ITL then
-    reflect scheduling and compute, not an arbitrary queue blow-up.
-    Returns serving throughput plus the scheduler's latency percentiles
-    (the BASELINE.md metrics of record: tokens/sec/chip and p50 TTFT).
+    Two phases: (1) a saturated all-at-once backlog measures peak
+    sustained serving throughput; (2) staggered arrivals at
+    `utilization` x that measured capacity give TTFT/ITL percentiles
+    under a stable queue (not an arbitrary queue blow-up).
+    Returns both (the BASELINE.md metrics of record: tokens/sec/chip
+    and p50 TTFT).
     """
     import jax
     from butterfly_tpu.core.config import RuntimeConfig
@@ -153,17 +154,29 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     for _ in range(2):
         warm.submit(prompt(), max_new_tokens=4)
     warm.run_until_done()
-    probe = Scheduler(engine)
-    preq = probe.submit(prompt(), max_new_tokens=64)
-    probe.tick()  # admission + first dispatches (tokens drain later)
-    n0 = len(preq.output)
-    t0 = time.perf_counter()
-    while not preq.done:
-        probe.tick()
-    t_step = (time.perf_counter() - t0) / max(1, len(preq.output) - n0)
+    # Phase 1 — MEASURED saturated capacity: submit a standing backlog
+    # all at once and time the drain. Every earlier attempt to MODEL
+    # sustained capacity from probe tick times (decode-only, then
+    # +prefill charge) overshot the real number — full-batch runs pay
+    # costs a one-request probe can't see (per-step table syncs, host
+    # accept loops) — and an overshooting offered rate turns the TTFT
+    # percentiles into a measure of the arrival schedule.
+    sat = Scheduler(engine)
+    sat_reqs = [sat.submit(prompt(), max_new_tokens=max_new)
+                for _ in range(int(1.5 * max_batch))]
+    t_start = time.monotonic()
+    sat.run_until_done(max_ticks=10 ** 6)
+    # Whole-run average, deliberately: it includes the admission ramp
+    # and drain tail, so it slightly UNDERSTATES peak throughput — but
+    # phase 2's steady state pays continuous admissions too, and a
+    # window that excludes admission overhead overshoots the offered
+    # rate and turns the TTFT percentiles into a measure of queue
+    # growth (tried; the tail bias is the lesser distortion).
+    capacity = (sat.metrics()["tokens_generated_total"]
+                / (time.monotonic() - t_start))
+    assert all(r.state == "finished" for r in sat_reqs)
 
-    # offered rate = utilization * capacity (capacity: every slot busy)
-    capacity = max_batch / t_step
+    # Phase 2 — staggered arrivals at utilization * measured capacity
     interarrival = max_new / (utilization * capacity)
 
     sched = Scheduler(engine)
@@ -186,7 +199,7 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     assert all(r.state == "finished" for r in reqs)
     out = {
         "serving_tokens_per_sec_per_chip": m["tokens_generated_total"] / wall,
-        # decode capacity with every slot busy (probe-measured): the
+        # MEASURED saturated throughput (phase-1 standing backlog); the
         # stable-queue throughput above approaches utilization * this
         "serving_capacity_tokens_per_sec": capacity,
         "serving_requests": n_requests,

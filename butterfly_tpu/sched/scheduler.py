@@ -239,7 +239,22 @@ class Scheduler:
         self._drain_inflight()
         self._admit()
         spec = self.engine.runtime.speculative_gamma > 0
-        for _ in range(max(1, self.engine.runtime.decode_steps_per_tick)):
+        k = max(1, self.engine.runtime.decode_steps_per_tick)
+        if not spec:
+            # Preallocate the whole tick's pages up front: the per-step
+            # growth checks below then find capacity already there, so
+            # the block table dirties (and syncs to the device) at most
+            # once per TICK instead of once per chained dispatch —
+            # measured as a large share of the full-batch serving gap
+            # (docs/decode_profile_r5.md capacity section).
+            # k+1 = the worst per-step need below (depth k-1, +2) — any
+            # more would add spurious page pressure in a tight pool
+            for req in list(self.running):
+                if req in self.running:
+                    need = min(len(req.all_tokens) + k + 1,
+                               len(req.prompt) + req.max_new_tokens)
+                    self._ensure_or_preempt(req, need)
+        for _ in range(k):
             if self.running:
                 self._spec_step() if spec else self._decode_step()
         return int(self._metrics["tokens_generated_total"] - before)
