@@ -361,20 +361,11 @@ class Scheduler:
                 (req, req.preemptions, req.slot, first))
 
     def _decode_step(self) -> None:
-        # just-in-time page growth (may preempt the youngest requests).
-        # The host's view lags the in-flight queue, so this dispatch may
-        # write len(queue)+1 positions past what all_tokens implies —
-        # but never more than the request's lifetime maximum (clamping
-        # matters: a request sized exactly to the per-seq page cap would
-        # otherwise self-preempt forever chasing unneeded slack; its
-        # post-finish in-flight writes beyond the table land on the
-        # null page by construction, cache/paged.write_paged_layer).
-        depth = len(self._inflight)
-        for req in list(self.running):
-            if req in self.running:  # may have been preempted as a victim
-                need = min(len(req.all_tokens) + depth + 2,
-                           len(req.prompt) + req.max_new_tokens)
-                self._ensure_or_preempt(req, need)
+        # Page growth happened at tick start (tick()'s preallocation
+        # covers every chained dispatch of the tick: its len+k+1 bound
+        # dominates any step's len+depth+2 with depth <= k-1, and the
+        # running set can only shrink between dispatches), so this
+        # dispatch only assembles operands and chains the step.
         if not self.running:
             return
 

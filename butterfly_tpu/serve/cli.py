@@ -35,9 +35,16 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--data-parallel", type=int, default=1)
         sp.add_argument("--seq-parallel", type=int, default=1,
                         help="sequence/context parallelism: shard the "
-                             "prompt over N devices (ring attention; "
-                             "the long-context path — prefix KV stays "
-                             "sharded where it was computed)")
+                             "prompt over N devices (the long-context "
+                             "path — prefix KV stays sharded where it "
+                             "was computed)")
+        sp.add_argument("--seq-impl", choices=["ring", "ulysses"],
+                        default="ring",
+                        help="sequence-parallel attention: 'ring' "
+                             "(ppermute K/V rotation, no head-count "
+                             "constraint) or 'ulysses' (all_to_all "
+                             "head<->sequence reshard; needs heads "
+                             "divisible by / replicable over the axis)")
         sp.add_argument("--max-seq", type=int, default=2048)
         sp.add_argument("--dcn-axes", default="data",
                         help="comma list of mesh axes to place ACROSS TPU "
@@ -230,7 +237,8 @@ def cmd_generate(args) -> int:
             return 2
         # long-context path: sp_forward prefill + sp_decode_step loop
         # (engine.generate_long docs)
-        res = engine.generate_long(ids, sp, seed=args.seed)
+        res = engine.generate_long(ids, sp, seed=args.seed,
+                                   impl=args.seq_impl)
         dt = time.perf_counter() - t0
         n = int(res.lengths[0])
         print(tok.decode(res.tokens[0, :n].tolist()))

@@ -244,7 +244,8 @@ def test_generate_long_engine_parity():
 
 def test_generate_long_cli_parity(capsys):
     """The CLI path (`generate --seq-parallel 4`) end to end: same text
-    as the unmeshed engine decoding the same byte prompt."""
+    as the unmeshed engine decoding the same byte prompt — for BOTH
+    sequence-parallel attention implementations (--seq-impl)."""
     from butterfly_tpu.engine import InferenceEngine, SamplingParams
     from butterfly_tpu.serve.cli import main
     from butterfly_tpu.utils.tokenizer import ByteTokenizer
@@ -253,6 +254,13 @@ def test_generate_long_cli_parity(capsys):
                "--prompt", "hello", "--max-new", "6"])
     assert rc == 0
     cli_text = capsys.readouterr().out.rstrip("\n")
+
+    rc = main(["generate", "--model", "tiny", "--seq-parallel", "4",
+               "--seq-impl", "ulysses", "--prompt", "hello",
+               "--max-new", "6"])
+    assert rc == 0
+    uly_text = capsys.readouterr().out.rstrip("\n")
+    assert uly_text == cli_text
 
     cfg = tiny("llama", dtype="float32", param_dtype="float32")
     tok = ByteTokenizer()
