@@ -610,7 +610,16 @@ def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
     state = ServerState(scheduler, tokenizer, max_queue,
                         heartbeat=heartbeat, model_name=model_name)
     state.thread.start()
-    httpd = ThreadingHTTPServer((host, port), make_handler(state))
+    # stdlib default listen backlog is 5: a burst of concurrent clients
+    # gets connection resets before the accept loop ever sees them
+    # (observed at 50 simultaneous connects in the r5 soak). Size it
+    # with the admission queue — excess load should get a 503/429 from
+    # US, not a TCP reset from the kernel. Local subclass so the bump
+    # stays per-server instead of mutating the shared stdlib class.
+    class _Server(ThreadingHTTPServer):
+        request_queue_size = max(128, max_queue)
+
+    httpd = _Server((host, port), make_handler(state))
     state.httpd = httpd
     if ready_event is not None:
         ready_event.set()
