@@ -65,6 +65,11 @@ class ServingEngine:
         self.model = model
         self.cfg = model.cfg
         self.runtime = runtime or RuntimeConfig()
+        # Optional obs.trace.Tracer (the scheduler shares its own when
+        # tracing is on): emits engine-level dispatch events — prefill
+        # bucket shapes and block-table syncs — into the global ring.
+        # None (the default) keeps every dispatch a single None check.
+        self.tracer = None
         self.params = cast_params(params, self.cfg)
         self.mesh = mesh
         stage = mesh.shape.get("stage", 1) if mesh is not None else 1
@@ -164,6 +169,10 @@ class ServingEngine:
         tbl = jax.device_put(self._host_table, self._table_sharding)
         self.cache = self.cache._replace(page_table=tbl)
         self._table_dirty = False
+        if self.tracer is not None:
+            # table syncs are a measured share of the full-batch serving
+            # gap (docs/decode_profile_r5.md) — count them in the trace
+            self.tracer.event(None, "engine.table_sync")
 
     def prefill_slot(self, slot: int, prompt: list[int]) -> jax.Array:
         """Run one request's whole prompt; returns last-token logits [V]."""
@@ -179,6 +188,10 @@ class ServingEngine:
         buf = np.zeros((1, T), np.int32)
         buf[0, :len(tokens)] = tokens
         prog = self._prefill if start == 0 else self._prefill_warm
+        if self.tracer is not None:
+            self.tracer.event(None, "engine.prefill_dispatch", slot=slot,
+                              tokens=len(tokens), bucket=T, start=start,
+                              fresh=start == 0)
         self._sync_table()
         with self._mesh_ctx():
             # pools are donated (scatters land in place); the slot's table

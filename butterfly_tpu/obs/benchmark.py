@@ -174,7 +174,13 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     # growth (tried; the tail bias is the lesser distortion).
     capacity = (sat.metrics()["tokens_generated_total"]
                 / (time.monotonic() - t_start))
-    assert all(r.state == "finished" for r in sat_reqs)
+    # explicit raise, not assert: under `python -O` a stripped assert
+    # would let a silently-incomplete run report bogus throughput
+    unfinished = [r.id for r in sat_reqs if r.state != "finished"]
+    if unfinished:
+        raise RuntimeError(
+            f"serving benchmark phase 1 left requests unfinished "
+            f"(ids {unfinished[:8]}): throughput would be bogus")
 
     # Phase 2 — staggered arrivals at utilization * measured capacity
     interarrival = max_new / (utilization * capacity)
@@ -196,7 +202,11 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     wall = time.monotonic() - t_start
 
     m = sched.metrics()
-    assert all(r.state == "finished" for r in reqs)
+    unfinished = [r.id for r in reqs if r.state != "finished"]
+    if unfinished:
+        raise RuntimeError(
+            f"serving benchmark phase 2 left requests unfinished "
+            f"(ids {unfinished[:8]}): TTFT/ITL percentiles would be bogus")
     out = {
         "serving_tokens_per_sec_per_chip": m["tokens_generated_total"] / wall,
         # MEASURED saturated throughput (phase-1 standing backlog); the

@@ -3,15 +3,33 @@
 Reports BASELINE.json's metrics of record directly (tokens/sec/chip, TTFT
 percentiles, queue depth, KV-page occupancy — SURVEY.md §5). The reference
 only ever *planned* observability (/root/reference/CLAUDE.md:42).
+
+Two layers feed /metrics:
+
+* the legacy flat dict from ``Scheduler.metrics()`` (gauges + the
+  window-percentile snapshot keys), rendered here;
+* the typed instrument registry (obs/registry.py) — counters and
+  fixed-bucket histograms (``ttft_seconds``, ``itl_req_mean_seconds``,
+  ``queue_wait_seconds``, ...) with real ``_bucket``/``_sum``/``_count``
+  exposition. When both layers carry the same name the registry wins
+  (it has the authoritative TYPE and atomic reads).
 """
 from __future__ import annotations
 
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 
 PREFIX = "butterfly"
 
+# NB (ADVICE.md round 5): with pipelined decode dispatch, tokens surface
+# in per-tick stacked-drain BURSTS, so the raw-gap itl_p50/itl_p95 keys
+# bimodalize (p50 ~ 0, p95 ~ tick) and ttft_* includes up to one extra
+# tick of drain delay. Those keys keep their names for dashboard
+# continuity but their HELP text below marks the per-tick-burst
+# semantics; consumers who want the latency a streaming client actually
+# experiences should read itl_req_mean_* (per-request mean gap) or the
+# butterfly_ttft_seconds / butterfly_itl_req_mean_seconds histograms.
 HELP = {
     "requests_total": "Requests submitted",
     "requests_finished": "Requests completed",
@@ -21,11 +39,24 @@ HELP = {
     "active_requests": "Requests currently decoding",
     "kv_pages_free": "Free KV-cache pages",
     "kv_pages_total": "Total usable KV-cache pages",
-    "ttft_p50": "p50 time-to-first-token (seconds)",
-    "ttft_p95": "p95 time-to-first-token (seconds)",
-    "itl_p50": "p50 inter-token latency (seconds)",
-    "itl_p95": "p95 inter-token latency (seconds)",
-    "itl_max": "max inter-token latency in the recent window (seconds)",
+    "ttft_p50": "p50 time-to-first-token (seconds; stamped at the "
+                "stacked drain, so includes up to one tick of burst "
+                "delay — see ttft_seconds histogram)",
+    "ttft_p95": "p95 time-to-first-token (seconds; stamped at the "
+                "stacked drain — see ttft_seconds histogram)",
+    "itl_p50": "p50 inter-token latency (seconds; PER-TICK-BURST gap "
+               "semantics under pipelined dispatch — prefer "
+               "itl_req_mean_p50)",
+    "itl_p95": "p95 inter-token latency (seconds; PER-TICK-BURST gap "
+               "semantics under pipelined dispatch — prefer "
+               "itl_req_mean_p95)",
+    "itl_max": "max inter-token latency in the recent window (seconds; "
+               "per-tick-burst semantics)",
+    "itl_req_mean_p50": "p50 over finished requests of each request's "
+                        "MEAN inter-token gap (seconds) — the "
+                        "effective streaming rate a client experiences",
+    "itl_req_mean_p95": "p95 over finished requests of each request's "
+                        "MEAN inter-token gap (seconds)",
     "tokens_per_sec": "Decode throughput over the last window",
     "uptime_seconds": "Server uptime",
     "prefix_cache_hit_tokens": "Prompt tokens served from the prefix cache",
@@ -69,14 +100,26 @@ class ThroughputWindow:
             return sum(n for _, n in self._events) / span
 
 
-def render_prometheus(values: Dict[str, float]) -> str:
-    """Dict -> prometheus exposition text."""
+def render_prometheus(values: Dict[str, float],
+                      registry: Optional[object] = None) -> str:
+    """Dict (+ optional MetricsRegistry) -> prometheus exposition text.
+
+    Registry instruments render with full histogram series; dict keys
+    that collide with a registry instrument name are skipped so the
+    output never emits a metric name twice (the text format forbids it).
+    """
+    skip = set(registry.names()) if registry is not None else ()
     lines = []
     for name, val in sorted(values.items()):
+        if name in skip:
+            continue
         full = f"{PREFIX}_{name}"
         if name in HELP:
             lines.append(f"# HELP {full} {HELP[name]}")
             kind = "counter" if name in COUNTERS else "gauge"
             lines.append(f"# TYPE {full} {kind}")
         lines.append(f"{full} {float(val):g}")
-    return "\n".join(lines) + "\n"
+    text = "\n".join(lines) + "\n" if lines else ""
+    if registry is not None:
+        text += registry.render()
+    return text

@@ -1,0 +1,253 @@
+"""Typed metrics registry: Counter / Gauge / Histogram instruments.
+
+Replaces the scheduler's ad-hoc ``Dict[str, float]`` with real
+instruments so /metrics can expose *distributions* — fixed-bucket
+Prometheus histograms with ``_bucket``/``_sum``/``_count`` series —
+instead of deque-percentile snapshots whose semantics silently shift
+with the emission pattern (ADVICE.md round 5: deferred emission skews
+the raw itl_p50/p95 keys).
+
+Threading contract: ONE writer thread (the scheduler loop owns every
+inc()/observe(); the server's tick loop is the only thread that ticks),
+any number of reader threads (HTTP /metrics handlers). Counters and
+gauges are plain float slots — a read may be one update stale, never
+torn (CPython). Histograms take a small lock so a scrape never sees
+``_sum``/``_count`` disagree with the bucket totals; observe() runs
+per-request/per-tick, not per-token, so the lock is off the hot path.
+
+stdlib-only: importable without jax (tools/trace_report.py and the
+format tests run without a backend).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+# Fixed bucket ladders. Latency buckets span sub-ms host work up to a
+# minute of queueing; token/batch ladders are powers of two matching the
+# prefill bucketing (engine.serving.bucket_len) and slot counts.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+BATCH_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+TOKEN_BUCKETS: Tuple[float, ...] = (
+    16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce to a legal Prometheus metric name ([a-zA-Z_:][a-zA-Z0-9_:]*)."""
+    name = _NAME_BAD_CHARS.sub("_", str(name))
+    if not name or not _NAME_OK.match(name):
+        name = "_" + name
+    return name
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting ('+Inf' never reaches here)."""
+    return f"{float(v):g}"
+
+
+class Counter:
+    """Monotonic counter. Single-writer; inc() only goes up."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self, prefix: str) -> List[str]:
+        full = f"{prefix}_{self.name}" if prefix else self.name
+        out = []
+        if self.help:
+            out.append(f"# HELP {full} {self.help}")
+        out.append(f"# TYPE {full} counter")
+        out.append(f"{full} {_fmt(self._value)}")
+        return out
+
+
+class Gauge:
+    """Settable instantaneous value."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self, prefix: str) -> List[str]:
+        full = f"{prefix}_{self.name}" if prefix else self.name
+        out = []
+        if self.help:
+            out.append(f"# HELP {full} {self.help}")
+        out.append(f"# TYPE {full} gauge")
+        out.append(f"{full} {_fmt(self._value)}")
+        return out
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus exposition semantics.
+
+    ``_bucket{le="x"}`` series are CUMULATIVE and end with ``le="+Inf"``
+    == ``_count``; ``_sum`` is the total of observed values. Buckets are
+    fixed at construction — no dynamic rebucketing, so a long-lived
+    server's series never change shape under a dashboard.
+    """
+
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
+                 "_lock")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        bs = [float(b) for b in buckets]
+        if bs != sorted(bs) or len(set(bs)) != len(bs):
+            raise ValueError(f"bucket bounds must be strictly increasing: "
+                             f"{buckets}")
+        self.name = name
+        self.help = help
+        self.buckets = tuple(bs)
+        # per-bucket (non-cumulative) counts; the +Inf overflow is last
+        self._counts = [0] * (len(bs) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        # linear scan: the ladders are ~10-16 entries and observe() runs
+        # per-request / per-tick — bisect would be noise
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count) — atomic."""
+        with self._lock:
+            counts = list(self._counts)
+            s, c = self._sum, self._count
+        cum, running = [], 0
+        for n in counts:
+            running += n
+            cum.append(running)
+        return cum, s, c
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def render(self, prefix: str) -> List[str]:
+        full = f"{prefix}_{self.name}" if prefix else self.name
+        cum, s, c = self.snapshot()
+        out = []
+        if self.help:
+            out.append(f"# HELP {full} {self.help}")
+        out.append(f"# TYPE {full} histogram")
+        for bound, n in zip(self.buckets, cum):
+            out.append(f'{full}_bucket{{le="{_fmt(bound)}"}} {n}')
+        out.append(f'{full}_bucket{{le="+Inf"}} {cum[-1]}')
+        out.append(f"{full}_sum {_fmt(s)}")
+        out.append(f"{full}_count {c}")
+        return out
+
+
+class MetricsRegistry:
+    """Named instrument registry with idempotent get-or-create.
+
+    ``counter``/``gauge``/``histogram`` return the existing instrument
+    when the (sanitized) name is already registered — callers in
+    different layers can share an instrument by name without plumbing
+    object references through the stack.
+    """
+
+    def __init__(self, prefix: str = "butterfly"):
+        self.prefix = prefix
+        self._instruments: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        name = sanitize_name(name)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, **kw)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}")
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def names(self) -> Iterable[str]:
+        with self._lock:
+            return set(self._instruments)
+
+    def get(self, name: str):
+        return self._instruments.get(sanitize_name(name))
+
+    def value_dict(self) -> Dict[str, float]:
+        """Counter/gauge values as a flat dict (the legacy metrics()
+        shape; histograms are exposition-only and skipped)."""
+        with self._lock:
+            insts = list(self._instruments.values())
+        return {i.name: i.value for i in insts
+                if isinstance(i, (Counter, Gauge))}
+
+    def render(self) -> str:
+        """Prometheus exposition text for every instrument."""
+        with self._lock:
+            insts = sorted(self._instruments.items())
+        lines: List[str] = []
+        for _, inst in insts:
+            lines.extend(inst.render(self.prefix))
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,175 @@
+"""Per-request tracing: structured span events in a bounded ring.
+
+Every request admitted to the scheduler gets a timeline of structured
+events (submit, admit, prefill chunks, first token, preemption, finish)
+plus a global ring of scheduler-tick and engine-dispatch events — the
+per-request "where did the time go" view that aggregate percentiles
+can't answer (Orca's per-iteration scheduling and vLLM's production
+stack both lean on exactly this to debug tail latency; PAPERS.md).
+
+Overhead contract: when tracing is off the scheduler holds ``trace =
+None`` and every call site is a single attribute-is-None check — no
+event objects, no locks, no timestamps. When on, an event is one
+``time.monotonic()`` call plus an append to a bounded deque under an
+uncontended lock (the scheduler thread is the only writer; HTTP readers
+copy under the same lock).
+
+Memory is bounded twice: at most ``max_requests`` per-request timelines
+are retained (oldest evicted whole), and each timeline holds at most
+``max_events_per_request`` events (a pathological 100k-token generation
+cannot grow one timeline without bound). The global ring is a deque
+with ``maxlen``.
+
+stdlib-only: importable without jax (tools/trace_report.py runs on a
+dumped trace with no backend).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+
+class Tracer:
+    """Bounded in-memory trace store. One writer, many readers."""
+
+    def __init__(self, max_requests: int = 256,
+                 max_events_per_request: int = 512,
+                 max_global_events: int = 4096):
+        self.max_requests = max_requests
+        self.max_events_per_request = max_events_per_request
+        self._lock = threading.Lock()
+        # rid -> {"id", "request_id", "events": deque, "done": bool}
+        self._requests: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self._global: deque = deque(maxlen=max_global_events)
+        # anchor: monotonic timestamps in events convert to wall clock
+        # via (t - t0_monotonic) + t0_wall when a report wants dates
+        self.t0_monotonic = time.monotonic()
+        self.t0_wall = time.time()
+
+    # -- write side (scheduler / engine thread) -----------------------------
+
+    def begin_request(self, rid: int,
+                      request_id: Optional[str] = None, **attrs) -> None:
+        """Open a timeline for request `rid` (the scheduler's req.id).
+        `request_id` is the client-supplied passthrough id
+        (X-Request-Id / body "request_id"), kept verbatim so client-side
+        logs join against server traces."""
+        rec = {"id": rid, "request_id": request_id, "done": False,
+               "events": deque(maxlen=self.max_events_per_request)}
+        with self._lock:
+            # re-begin (same rid) replaces: ids are unique per scheduler
+            self._requests[rid] = rec
+            self._requests.move_to_end(rid)
+            while len(self._requests) > self.max_requests:
+                self._requests.popitem(last=False)
+        self.event(rid, "submit", **attrs)
+
+    def event(self, rid: Optional[int], name: str, **attrs) -> None:
+        """Record one span event. rid=None -> the global ring (scheduler
+        ticks, engine dispatches — events not owned by one request)."""
+        ev = {"t": time.monotonic(), "name": name}
+        if attrs:
+            ev.update(attrs)
+        with self._lock:
+            if rid is None:
+                self._global.append(ev)
+                return
+            rec = self._requests.get(rid)
+            if rec is None:
+                return  # evicted (or never begun): drop, never grow
+            rec["events"].append(ev)
+            if name == "finish":
+                rec["done"] = True
+
+    # -- read side (HTTP handlers / dump) -----------------------------------
+
+    def timeline(self, rid: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._requests.get(rid)
+            if rec is None:
+                return None
+            return {"id": rec["id"], "request_id": rec["request_id"],
+                    "done": rec["done"], "events": list(rec["events"])}
+
+    def timelines(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Most recent `n` request timelines, oldest first."""
+        with self._lock:
+            recs = [{"id": r["id"], "request_id": r["request_id"],
+                     "done": r["done"], "events": list(r["events"])}
+                    for r in self._requests.values()]
+        if n is not None and n >= 0:
+            recs = recs[-n:]
+        return recs
+
+    def global_events(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self._global)
+        if n is not None and n >= 0:
+            evs = evs[-n:]
+        return evs
+
+    def dump(self, n_requests: Optional[int] = None,
+             n_global: Optional[int] = None) -> Dict[str, Any]:
+        """JSON-ready snapshot: what /debug/requests returns and what
+        tools/trace_report.py consumes."""
+        return {
+            "t0_monotonic": self.t0_monotonic,
+            "t0_wall": self.t0_wall,
+            "requests": self.timelines(n_requests),
+            "global_events": self.global_events(n_global),
+        }
+
+    def dump_json(self, path: str, **kw) -> None:
+        with open(path, "w") as f:
+            json.dump(self.dump(**kw), f)
+
+
+def summarize_timeline(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Phase durations from one request's event list.
+
+    Returns queue_wait_s (submit->admit), prefill_s (admit->prefill
+    done), ttft_s (submit->first token), decode_s (first token->finish),
+    total_s, plus token/preemption counts pulled off the events. Missing
+    phases (aborted early, events evicted) come back as None — report
+    code prints '-' rather than inventing zeros.
+    """
+    by_name: Dict[str, Dict[str, Any]] = {}
+    preempts = 0
+    chunks = 0
+    for ev in rec.get("events", ()):
+        name = ev.get("name")
+        if name == "preempt":
+            preempts += 1
+        if name == "prefill_chunk":
+            chunks += 1
+        # keep the FIRST submit/admit/first_token and the LAST finish
+        if name == "finish" or name not in by_name:
+            by_name[name] = ev
+
+    def t(name):
+        ev = by_name.get(name)
+        return ev["t"] if ev else None
+
+    def delta(a, b):
+        ta, tb = t(a), t(b)
+        return (tb - ta) if ta is not None and tb is not None else None
+
+    finish = by_name.get("finish", {})
+    return {
+        "id": rec.get("id"),
+        "request_id": rec.get("request_id"),
+        "state": finish.get("state",
+                            "done" if rec.get("done") else "live"),
+        "queue_wait_s": delta("submit", "admit"),
+        "prefill_s": delta("admit", "prefill_done"),
+        "ttft_s": delta("submit", "first_token"),
+        "decode_s": delta("first_token", "finish"),
+        "total_s": delta("submit", "finish"),
+        "tokens": finish.get("tokens"),
+        "prefill_chunks": chunks,
+        "preemptions": preempts,
+        "events": len(rec.get("events", ())),
+    }

@@ -39,6 +39,8 @@ import numpy as np
 
 from butterfly_tpu.cache.allocator import make_page_allocator
 from butterfly_tpu.engine.serving import ServingEngine, sample_batched
+from butterfly_tpu.obs.registry import (
+    BATCH_BUCKETS, LATENCY_BUCKETS, TOKEN_BUCKETS, MetricsRegistry)
 
 
 @dataclass
@@ -48,6 +50,9 @@ class Request:
     max_new_tokens: int = 128
     temperature: float = 0.0
     stop_token: int = -1
+    # client-supplied passthrough id (X-Request-Id / body "request_id"):
+    # appears verbatim in traces so client logs join server timelines
+    client_id: Optional[str] = None
     # runtime state
     output: List[int] = field(default_factory=list)
     slot: Optional[int] = None
@@ -55,6 +60,12 @@ class Request:
     prefilled: int = 0      # prompt tokens already in the KV cache
     preemptions: int = 0
     t_arrive: float = field(default_factory=time.monotonic)
+    # last time the request entered the waiting queue (submit or
+    # preemption): the queue_wait_seconds histogram measures from here
+    t_enqueued: float = field(default_factory=time.monotonic)
+    # prefix-cache hit length at the LAST admission: prefill_tokens
+    # histogram observes len(prompt) - this (only tokens actually run)
+    cached_at_admit: int = 0
     t_first_token: Optional[float] = None
     t_last_token: Optional[float] = None
     t_finish: Optional[float] = None
@@ -80,8 +91,15 @@ class Request:
 class Scheduler:
     """Continuous batching over a ServingEngine."""
 
-    def __init__(self, engine: ServingEngine, seed: int = 0):
+    def __init__(self, engine: ServingEngine, seed: int = 0,
+                 tracer=None, registry: Optional[MetricsRegistry] = None):
         self.engine = engine
+        # Tracing is opt-in: trace=None keeps every hot-path call site a
+        # single None check (obs/trace.py overhead contract). When on,
+        # the engine shares the tracer for dispatch-level events.
+        self.trace = tracer
+        if tracer is not None and hasattr(engine, "tracer"):
+            engine.tracer = tracer
         rt = engine.runtime
         if rt.scheduler not in ("continuous", "static"):
             raise ValueError(f"unknown scheduler {rt.scheduler!r}: "
@@ -122,11 +140,48 @@ class Scheduler:
         # Admissions write their first token into it with a device-side
         # .at[].set, so dispatching never needs the host values.
         self._next_dev = None
-        self._metrics: Dict[str, float] = {
-            "requests_total": 0, "requests_finished": 0,
-            "tokens_generated_total": 0, "preemptions_total": 0,
-            "spec_forwards_total": 0, "spec_drafts_accepted_total": 0,
-        }
+        # Typed instruments (obs/registry.py) replace the old ad-hoc
+        # Dict[str, float]: counters for the monotonic totals, fixed-
+        # bucket histograms for the latency/size distributions /metrics
+        # exposes as real _bucket/_sum/_count series. metrics() still
+        # returns the legacy flat dict, assembled from the registry.
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        reg = self.registry
+        self._c_requests = reg.counter(
+            "requests_total", "Requests submitted")
+        self._c_finished = reg.counter(
+            "requests_finished", "Requests completed")
+        self._c_tokens = reg.counter(
+            "tokens_generated_total",
+            "Tokens generated across all requests")
+        self._c_preempt = reg.counter(
+            "preemptions_total",
+            "Recompute preemptions under page pressure")
+        self._c_spec_fwd = reg.counter(
+            "spec_forwards_total", "Speculative verify forwards")
+        self._c_spec_acc = reg.counter(
+            "spec_drafts_accepted_total",
+            "Draft tokens accepted by speculative verify")
+        self._h_ttft = reg.histogram(
+            "ttft_seconds",
+            "Time to first token (submit -> first token drained)",
+            LATENCY_BUCKETS)
+        self._h_itl_mean = reg.histogram(
+            "itl_req_mean_seconds",
+            "Per-finished-request MEAN inter-token gap — the effective "
+            "streaming rate a client experiences", LATENCY_BUCKETS)
+        self._h_queue_wait = reg.histogram(
+            "queue_wait_seconds",
+            "Wait from submit (or preemption) to slot admission",
+            LATENCY_BUCKETS)
+        self._h_batch = reg.histogram(
+            "batch_size", "Decoding slots active per scheduler tick",
+            BATCH_BUCKETS)
+        self._h_prefill_tokens = reg.histogram(
+            "prefill_tokens",
+            "Prompt tokens prefilled per admission (prefix-cache hits "
+            "excluded)", TOKEN_BUCKETS)
         # latency reservoirs: both bounded to the same recent window so
         # the two adjacent metrics share time-horizon semantics (and a
         # long-lived server doesn't leak one float per request forever)
@@ -146,7 +201,8 @@ class Scheduler:
 
     def submit(self, prompt: List[int], max_new_tokens: int = 128,
                temperature: float = 0.0, stop_token: int = -1,
-               on_token=None, on_finish=None) -> Request:
+               on_token=None, on_finish=None,
+               request_id: Optional[str] = None) -> Request:
         # Reject what can never fit: a request that exceeds the per-seq
         # page limit or the whole pool would self-preempt forever.
         worst = -(-(len(prompt) + max_new_tokens) // self.alloc.page_size)
@@ -162,10 +218,14 @@ class Scheduler:
                 "with temperature=0 or disable speculative_gamma")
         req = Request(id=next(self._ids), prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
-                      stop_token=stop_token, on_token=on_token,
-                      on_finish=on_finish)
+                      stop_token=stop_token, client_id=request_id,
+                      on_token=on_token, on_finish=on_finish)
         self.waiting.append(req)
-        self._metrics["requests_total"] += 1
+        self._c_requests.inc()
+        if self.trace is not None:
+            self.trace.begin_request(req.id, request_id=request_id,
+                                     prompt_len=len(prompt),
+                                     max_new_tokens=max_new_tokens)
         return req
 
     def cancel(self, req: Request) -> None:
@@ -199,6 +259,10 @@ class Scheduler:
         for req in self.unfinished_requests():
             req.state = "cancelled"
             req.t_finish = time.monotonic()
+            if self.trace is not None:
+                self.trace.event(req.id, "finish", state="cancelled",
+                                 reason="abort_all",
+                                 tokens=len(req.output))
             if req.slot is not None:
                 self.alloc.release(req.slot)
                 self.slots[req.slot] = None
@@ -232,7 +296,7 @@ class Scheduler:
         steps, bounding every decoding request's inter-token gap under
         admission pressure. Returns the number of tokens generated this
         round (throughput accounting for the serve loop)."""
-        before = self._metrics["tokens_generated_total"]
+        before = self._c_tokens.value
         # consume any step still in flight BEFORE admission: admission
         # must see finished slots, and a prefill dispatched over a stale
         # in-flight step would race the table sync
@@ -240,6 +304,8 @@ class Scheduler:
         self._admit()
         spec = self.engine.runtime.speculative_gamma > 0
         k = max(1, self.engine.runtime.decode_steps_per_tick)
+        if self.running:
+            self._h_batch.observe(len(self.running))
         if not spec:
             # Preallocate the whole tick's pages up front: the per-step
             # growth checks below then find capacity already there, so
@@ -257,10 +323,34 @@ class Scheduler:
         for _ in range(k):
             if self.running:
                 self._spec_step() if spec else self._decode_step()
-        return int(self._metrics["tokens_generated_total"] - before)
+        made = int(self._c_tokens.value - before)
+        if self.trace is not None:
+            # one global event per tick: the decode batch this round —
+            # slot composition plus what the stacked drain surfaced
+            self.trace.event(None, "decode_tick",
+                             batch=len(self.running),
+                             waiting=len(self.waiting),
+                             steps=k, generated=made)
+        return made
 
     def metrics(self) -> Dict[str, float]:
-        m = dict(self._metrics)
+        """Legacy flat-dict view, assembled from the typed registry.
+
+        NB: the itl_p50/itl_p95/itl_max (and, to one tick, ttft_*) keys
+        carry PER-TICK-BURST semantics under pipelined dispatch — gaps
+        are stamped at the stacked drain, so the raw percentiles
+        bimodalize (p50 ~ 0, p95 ~ tick). Consumers should prefer
+        itl_req_mean_* or the registry's real histograms
+        (ttft_seconds, itl_req_mean_seconds); see obs/metrics.py HELP.
+        """
+        m: Dict[str, float] = {
+            "requests_total": self._c_requests.value,
+            "requests_finished": self._c_finished.value,
+            "tokens_generated_total": self._c_tokens.value,
+            "preemptions_total": self._c_preempt.value,
+            "spec_forwards_total": self._c_spec_fwd.value,
+            "spec_drafts_accepted_total": self._c_spec_acc.value,
+        }
         m["queue_depth"] = len(self.waiting)
         m["active_requests"] = len(self._all_live)
         m["kv_pages_free"] = self.alloc.free_pages
@@ -319,10 +409,17 @@ class Scheduler:
                     return  # pool exhausted; decode will free/preempt
                 self.waiting.popleft()
                 req.slot, req.state = slot, "prefilling"
-                req.prefilled = cached
+                req.prefilled = req.cached_at_admit = cached
                 self.slots[slot] = req
                 self._prefilling = req
                 self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+                wait = time.monotonic() - req.t_enqueued
+                self._h_queue_wait.observe(wait)
+                if self.trace is not None:
+                    self.trace.event(req.id, "admit", slot=slot,
+                                     queue_wait_s=wait,
+                                     prefix_cache_hit_tokens=cached,
+                                     resumed=req.preemptions > 0)
                 # (no length bookkeeping for `cached` needed: the first
                 # warm chunk below runs in this same call and sets
                 # lengths[slot] = cached + len(chunk))
@@ -332,6 +429,9 @@ class Scheduler:
             end = len(prefix) if budget is None \
                 else min(len(prefix), req.prefilled + budget)
             chunk = prefix[req.prefilled:end]
+            if self.trace is not None:
+                self.trace.event(req.id, "prefill_chunk",
+                                 start=req.prefilled, tokens=len(chunk))
             logits = self.engine.prefill_chunk(req.slot, chunk, req.prefilled)
             req.prefilled = end
             if budget is not None:
@@ -349,6 +449,11 @@ class Scheduler:
             self._prefilling = None
             req.state = "running"
             self.running.append(req)
+            self._h_prefill_tokens.observe(len(prefix) - req.cached_at_admit)
+            if self.trace is not None:
+                self.trace.event(req.id, "prefill_done",
+                                 tokens=len(prefix) - req.cached_at_admit,
+                                 total=len(prefix))
             self._key, sub = jax.random.split(self._key)
             first = sample_batched(
                 logits[None], sub,
@@ -424,7 +529,7 @@ class Scheduler:
             drafts[req.slot] = d
             active[req.slot] = True
         greedy = self.engine.verify_active(toks, active)
-        self._metrics["spec_forwards_total"] += 1
+        self._c_spec_fwd.inc()
 
         mask = np.zeros((S,), bool)
         vals = np.zeros((S,), np.int32)
@@ -438,8 +543,7 @@ class Scheduler:
                     break
             # count only drafts actually EMITTED (stop/max_new may
             # truncate mid-group); the first token isn't a draft
-            self._metrics["spec_drafts_accepted_total"] += max(
-                0, len(req.output) - n_before - 1)
+            self._c_spec_acc.inc(max(0, len(req.output) - n_before - 1))
             if req.slot is not None:  # still running: roll length back
                 mask[slot] = True
                 vals[slot] = len(req.all_tokens) - 1
@@ -489,11 +593,14 @@ class Scheduler:
         if req.t_first_token is None:
             req.t_first_token = now
             self._ttfts.append(req.ttft)
+            self._h_ttft.observe(req.ttft)
+            if self.trace is not None:
+                self.trace.event(req.id, "first_token", ttft_s=req.ttft)
         else:
             self._itls.append(now - req.t_last_token)
         req.t_last_token = now
         req.output.append(token)
-        self._metrics["tokens_generated_total"] += 1
+        self._c_tokens.inc()
         if req.on_token is not None:
             req.on_token(req, token)
         hit_stop = req.stop_token >= 0 and token == req.stop_token
@@ -503,9 +610,10 @@ class Scheduler:
     def _finish(self, req: Request, state: str = "finished") -> None:
         if state == "finished" and len(req.output) > 1 and \
                 req.t_first_token is not None:
-            self._itl_means.append(
-                (req.t_last_token - req.t_first_token)
-                / (len(req.output) - 1))
+            mean_gap = ((req.t_last_token - req.t_first_token)
+                        / (len(req.output) - 1))
+            self._itl_means.append(mean_gap)
+            self._h_itl_mean.observe(mean_gap)
         if req.slot is not None:
             # publish the written tokens' full pages before releasing
             # (the latest sampled token's K/V is never written — it
@@ -523,7 +631,12 @@ class Scheduler:
         if req in self.running:
             self.running.remove(req)
         if state == "finished":
-            self._metrics["requests_finished"] += 1
+            self._c_finished.inc()
+        if self.trace is not None:
+            self.trace.event(req.id, "finish", state=state,
+                             tokens=len(req.output),
+                             preemptions=req.preemptions,
+                             ttft_s=req.ttft)
         if req.on_finish is not None:
             req.on_finish(req)
 
@@ -545,18 +658,35 @@ class Scheduler:
     def _written(self, req: Request) -> int:
         """Tokens whose K/V the device has actually written for req's
         slot: everything prefilled, plus decoded tokens except the last
-        sampled one (written on the next step, which never ran)."""
+        sampled one (written on the next step, which never ran).
+
+        A running request whose device-sampled FIRST token has not yet
+        drained (output still empty, entry in _pending_first) has every
+        one of its all_tokens (= the whole prompt) written by prefill —
+        the undrained first token is not in all_tokens, so there is no
+        trailing unwritten sample to subtract (ADVICE.md r5: the old
+        blanket -1 under-registered a full page at page boundaries)."""
         if req.state == "prefilling":
             return req.prefilled
+        if not req.output and any(
+                f[0] is req and f[1] == req.preemptions
+                for f in self._pending_first):
+            return len(req.all_tokens)
         return len(req.all_tokens) - 1
 
     def _preempt(self, req: Request) -> None:
         """Recompute-style preemption: free pages, requeue at the front.
         With prefix caching the pages stay warm in the registry, so
         readmission's "recompute" is usually a cache hit."""
-        self._metrics["preemptions_total"] += 1
-        req.preemptions += 1
+        self._c_preempt.inc()
+        if self.trace is not None:
+            self.trace.event(req.id, "preempt", slot=req.slot,
+                             preemptions=req.preemptions + 1,
+                             generated=len(req.output))
+        # register BEFORE bumping the generation: _written's pending-
+        # first-token check matches entries queued under the current one
         self.alloc.register(req.slot, req.all_tokens[:self._written(req)])
+        req.preemptions += 1
         self.alloc.release(req.slot)
         self.engine.reset_slot(req.slot)
         self.slots[req.slot] = None
@@ -565,4 +695,5 @@ class Scheduler:
         # all_tokens (prompt + output) are recomputed on readmission
         req.state = "waiting"
         req.prefilled = 0
+        req.t_enqueued = time.monotonic()
         self.waiting.appendleft(req)

@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving-wide top-k sampling filter")
     s.add_argument("--top-p", type=float, default=1.0)
     s.add_argument("--max-queue", type=int, default=256)
+    s.add_argument("--no-trace", action="store_true",
+                   help="disable per-request tracing (GET /debug/requests "
+                        "then reports enabled=false); tracing is on by "
+                        "default and costs one ring-buffer append per "
+                        "scheduling event")
     s.add_argument("--prefix-caching", action="store_true",
                    help="reuse KV pages across requests sharing a prompt "
                         "prefix (content-hashed, refcounted; cuts TTFT for "

@@ -15,8 +15,14 @@ zero-egress environment):
   up to 4 strings, matched on decoded text with streaming holdback),
   "stream"} -> {"id", "object": "text_completion", "choices": [{"text",
   "finish_reason"}], "usage"}; streaming sends OpenAI-style SSE chunks.
-* GET /metrics    Prometheus text (obs/metrics.py)
+* GET /metrics    Prometheus text (obs/metrics.py + the typed registry's
+  histogram series — obs/registry.py)
 * GET /health     {"status": "ok"}
+* GET /debug/requests[?n=K]   recent per-request trace timelines as JSON
+  (obs/trace.py; requires the scheduler to be built with a Tracer —
+  returns {"enabled": false} otherwise). Clients may tag requests with
+  an `X-Request-Id` header or a `request_id` body field; the id rides
+  the trace verbatim so client logs join server timelines.
 
 One scheduler thread owns all device work (ticks); HTTP handler threads
 only enqueue requests and wait on per-request queues — JAX never runs on
@@ -184,7 +190,8 @@ class ServerState:
 
     # -- handler-thread API ---------------------------------------------------
 
-    def submit(self, tokens, max_tokens, temperature, stop_token):
+    def submit(self, tokens, max_tokens, temperature, stop_token,
+               request_id=None):
         q: queue.Queue = queue.Queue()
 
         def on_token(req, token):
@@ -203,7 +210,8 @@ class ServerState:
             req = self.sched.submit(tokens, max_new_tokens=max_tokens,
                                     temperature=temperature,
                                     stop_token=stop_token,
-                                    on_token=on_token, on_finish=on_finish)
+                                    on_token=on_token, on_finish=on_finish,
+                                    request_id=request_id)
         self.wake.set()
         return req, q
 
@@ -212,7 +220,20 @@ class ServerState:
             vals = self.sched.metrics()
         vals["tokens_per_sec"] = self.throughput.rate()
         vals["uptime_seconds"] = time.monotonic() - self.t_start
-        return render_prometheus(vals)
+        return render_prometheus(vals,
+                                 registry=getattr(self.sched, "registry",
+                                                  None))
+
+    def debug_requests(self, n: Optional[int] = None) -> dict:
+        """Recent per-request trace timelines (the /debug/requests
+        body). Reads only the tracer's own lock — a wedged scheduler
+        (hung tick holding self.lock) can still be inspected."""
+        tracer = getattr(self.sched, "trace", None)
+        if tracer is None:
+            return {"enabled": False, "requests": []}
+        dump = tracer.dump(n_requests=n)
+        dump["enabled"] = True
+        return dump
 
 
 def make_handler(state: ServerState):
@@ -248,8 +269,19 @@ def make_handler(state: ServerState):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.split("?")[0] == "/debug/requests":
+                self._json(200, state.debug_requests(self._query_n()))
             else:
                 self._json(404, {"error": "not found"})
+
+        def _query_n(self):
+            """?n=K limit for /debug/requests; None when absent/bad."""
+            from urllib.parse import parse_qs, urlparse
+            try:
+                qs = parse_qs(urlparse(self.path).query)
+                return int(qs["n"][0]) if "n" in qs else None
+            except (ValueError, TypeError, IndexError):
+                return None
 
         def do_POST(self):
             if self.path == "/generate":
@@ -297,7 +329,11 @@ def make_handler(state: ServerState):
             stop = int(body.get("stop_token",
                                 -1 if state.tok.eos_id is None
                                 else state.tok.eos_id))
-            return tokens, max_tokens, temperature, stop
+            # client trace-correlation id: header wins over body field
+            rid = self.headers.get("X-Request-Id") \
+                or body.get("request_id")
+            rid = str(rid)[:128] if rid is not None else None
+            return tokens, max_tokens, temperature, stop, rid
 
         def _admit(self, body: dict, openai: bool = False):
             """Parse + submit; handles every error response (in the
@@ -311,7 +347,7 @@ def make_handler(state: ServerState):
                     self._json(code, {"error": msg})
 
             try:
-                tokens, max_tokens, temperature, stop = \
+                tokens, max_tokens, temperature, stop, rid = \
                     self._parse_request(body)
             except (ValueError, TypeError, KeyError) as e:
                 err(400, str(e), "invalid_request_error")
@@ -320,7 +356,8 @@ def make_handler(state: ServerState):
                 err(503, "server wedged: " + state.error, "server_error")
                 return None
             try:
-                req, q = state.submit(tokens, max_tokens, temperature, stop)
+                req, q = state.submit(tokens, max_tokens, temperature, stop,
+                                      request_id=rid)
             except ValueError as e:  # can never fit the page pool
                 err(400, str(e), "invalid_request_error")
                 return None
@@ -655,7 +692,14 @@ def run_server(args) -> int:
                        decode_steps_per_tick=getattr(
                            args, "decode_steps_per_tick", 1))
     engine = ServingEngine(model, params, rt, mesh=mesh)
-    sched = Scheduler(engine)
+    # Tracing defaults ON for the serve entrypoint (/debug/requests is
+    # the production debugging surface); --no-trace turns it off for
+    # benchmarking the bare hot path.
+    tracer = None
+    if not getattr(args, "no_trace", False):
+        from butterfly_tpu.obs.trace import Tracer
+        tracer = Tracer()
+    sched = Scheduler(engine, tracer=tracer)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk
     # continuation, batched decode) before listening: the first user
     # doesn't pay 20-40s of XLA compile, and the heartbeat watchdog

@@ -1,0 +1,263 @@
+"""Observability-layer tests: metrics registry exposition format,
+trace ring-buffer semantics, and the tools/trace_report.py smoke run.
+
+All jax-free (registry/trace are stdlib-only) so they run in any
+environment the suite does, including JAX_PLATFORMS=cpu CI.
+"""
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from butterfly_tpu.obs.metrics import render_prometheus
+from butterfly_tpu.obs.registry import (
+    LATENCY_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    sanitize_name)
+from butterfly_tpu.obs.trace import Tracer, summarize_timeline
+
+REPO = Path(__file__).parent.parent
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_counter_monotonic():
+    c = Counter("reqs", "h")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    g = Gauge("depth")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value == 6
+
+
+def test_histogram_buckets_cumulative_and_consistent():
+    h = Histogram("lat", "h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    cum, s, c = h.snapshot()
+    # cumulative per-le counts: <=0.1 ->1, <=1 ->3, <=10 ->4, +Inf ->5
+    assert cum == [1, 3, 4, 5]
+    assert cum == sorted(cum), "bucket series must be monotonic"
+    assert c == 5 and cum[-1] == c, "+Inf bucket must equal _count"
+    assert s == pytest.approx(0.05 + 0.5 + 0.5 + 5.0 + 50.0)
+
+
+def test_histogram_rejects_bad_buckets():
+    for bad in ((), (1.0, 1.0), (2.0, 1.0)):
+        with pytest.raises(ValueError):
+            Histogram("h", buckets=bad)
+
+
+def test_histogram_render_format():
+    h = Histogram("ttft_seconds", "ttft", buckets=(0.5, 2.0))
+    h.observe(0.3)
+    h.observe(1.0)
+    h.observe(99.0)
+    lines = h.render("butterfly")
+    assert "# HELP butterfly_ttft_seconds ttft" in lines
+    assert "# TYPE butterfly_ttft_seconds histogram" in lines
+    assert 'butterfly_ttft_seconds_bucket{le="0.5"} 1' in lines
+    assert 'butterfly_ttft_seconds_bucket{le="2"} 2' in lines
+    assert 'butterfly_ttft_seconds_bucket{le="+Inf"} 3' in lines
+    assert "butterfly_ttft_seconds_sum 100.3" in lines
+    assert "butterfly_ttft_seconds_count 3" in lines
+    # bucket lines come before _sum/_count, bounds in ascending order
+    text = "\n".join(lines)
+    assert text.index('le="0.5"') < text.index('le="2"') \
+        < text.index('le="+Inf"') < text.index("_sum")
+
+
+def test_registry_get_or_create_idempotent():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "help")
+    b = reg.counter("x_total")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # same name, different type
+
+
+def test_name_sanitization():
+    assert sanitize_name("a.b-c d") == "a_b_c_d"
+    assert sanitize_name("0abc").startswith("_")
+    reg = MetricsRegistry()
+    c = reg.counter("bad.name-1")
+    c.inc()
+    out = reg.render()
+    assert "butterfly_bad_name_1 1" in out
+    # every exposed sample line is a legal prometheus series
+    for line in out.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$", line), \
+            line
+
+
+def test_render_prometheus_registry_wins_name_collisions():
+    reg = MetricsRegistry()
+    reg.counter("requests_total", "from registry").inc(7)
+    reg.histogram("ttft_seconds", "ttft", buckets=LATENCY_BUCKETS)
+    text = render_prometheus({"requests_total": 3, "queue_depth": 2},
+                             registry=reg)
+    # the dict copy of the colliding name is suppressed: exactly one
+    # requests_total sample line, carrying the registry's value
+    samples = [l for l in text.splitlines()
+               if l.startswith("butterfly_requests_total ")]
+    assert samples == ["butterfly_requests_total 7"]
+    assert "butterfly_queue_depth 2" in text
+    assert "butterfly_ttft_seconds_bucket" in text
+
+
+def test_render_prometheus_plain_dict_unchanged():
+    text = render_prometheus({"tokens_generated_total": 5})
+    assert "# TYPE butterfly_tokens_generated_total counter" in text
+    assert "butterfly_tokens_generated_total 5" in text
+
+
+# -- tracer -----------------------------------------------------------------
+
+def test_tracer_timeline_roundtrip():
+    tr = Tracer()
+    tr.begin_request(1, request_id="client-abc", prompt_len=3)
+    tr.event(1, "admit", slot=0, queue_wait_s=0.01)
+    tr.event(1, "first_token", ttft_s=0.02)
+    tr.event(1, "finish", state="finished", tokens=4)
+    tl = tr.timeline(1)
+    assert tl["request_id"] == "client-abc"
+    assert tl["done"] is True
+    names = [e["name"] for e in tl["events"]]
+    assert names == ["submit", "admit", "first_token", "finish"]
+    ts = [e["t"] for e in tl["events"]]
+    assert ts == sorted(ts)
+
+
+def test_tracer_bounds_requests_and_events():
+    tr = Tracer(max_requests=2, max_events_per_request=3)
+    for rid in range(4):
+        tr.begin_request(rid)
+    assert [t["id"] for t in tr.timelines()] == [2, 3]
+    for _ in range(10):
+        tr.event(3, "decode")
+    assert len(tr.timeline(3)["events"]) == 3
+    # events for evicted/unknown requests are dropped, not resurrected
+    tr.event(0, "late")
+    assert tr.timeline(0) is None
+
+
+def test_tracer_global_ring():
+    tr = Tracer(max_global_events=4)
+    for i in range(10):
+        tr.event(None, "decode_tick", batch=i)
+    evs = tr.global_events()
+    assert len(evs) == 4
+    assert [e["batch"] for e in evs] == [6, 7, 8, 9]
+
+
+def test_summarize_timeline_phases():
+    tr = Tracer()
+    tr.begin_request(7, request_id="r7")
+    tr.event(7, "admit", slot=0)
+    tr.event(7, "prefill_chunk", start=0, tokens=8)
+    tr.event(7, "prefill_done", tokens=8)
+    tr.event(7, "first_token", ttft_s=0.1)
+    tr.event(7, "finish", state="finished", tokens=5)
+    s = summarize_timeline(tr.timeline(7))
+    assert s["id"] == 7 and s["request_id"] == "r7"
+    assert s["state"] == "finished" and s["tokens"] == 5
+    assert s["prefill_chunks"] == 1 and s["preemptions"] == 0
+    for k in ("queue_wait_s", "prefill_s", "ttft_s", "decode_s", "total_s"):
+        assert s[k] is not None and s[k] >= 0
+    # partial timeline: missing phases are None, not fabricated zeros
+    tr.begin_request(8)
+    s8 = summarize_timeline(tr.timeline(8))
+    assert s8["ttft_s"] is None and s8["total_s"] is None
+    assert s8["state"] == "live"
+
+
+def test_tracer_dump_is_json_serializable():
+    tr = Tracer()
+    tr.begin_request(0, request_id=None)
+    tr.event(0, "finish", state="finished", tokens=1)
+    tr.event(None, "decode_tick", batch=1)
+    blob = json.dumps(tr.dump())
+    back = json.loads(blob)
+    assert back["requests"][0]["id"] == 0
+    assert back["global_events"][0]["name"] == "decode_tick"
+
+
+# -- tools/trace_report.py smoke --------------------------------------------
+
+def _synthetic_dump(path):
+    tr = Tracer()
+    for rid in range(3):
+        tr.begin_request(rid, request_id=f"client-{rid}", prompt_len=8)
+        tr.event(rid, "admit", slot=rid % 2, queue_wait_s=0.001)
+        tr.event(rid, "prefill_chunk", start=0, tokens=8)
+        tr.event(rid, "prefill_done", tokens=8)
+        tr.event(rid, "first_token", ttft_s=0.01)
+        if rid == 1:
+            tr.event(rid, "preempt", slot=1, preemptions=1)
+            tr.event(rid, "admit", slot=0, resumed=True)
+        tr.event(rid, "finish", state="finished", tokens=4)
+    for i in range(5):
+        tr.event(None, "decode_tick", batch=2, generated=2)
+    tr.dump_json(str(path))
+    return path
+
+
+def test_trace_report_summary_and_timeline(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", REPO / "tools" / "trace_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    dump = _synthetic_dump(tmp_path / "trace.json")
+    rows = mod.summary_rows(mod.load_dump(str(dump)))
+    assert len(rows) == 3
+    assert rows[1]["preemptions"] == 1
+    text = mod.render_summary(mod.load_dump(str(dump)))
+    assert "client-0" in text and "3 request(s)" in text
+    assert "5 global event(s), 5 decode tick(s)" in text
+    tl = mod.render_timeline(mod.load_dump(str(dump)), 1)
+    assert "preempt" in tl and "request_id=client-1" in tl
+    with pytest.raises(ValueError):
+        mod.render_timeline(mod.load_dump(str(dump)), 99)
+    # a non-dump JSON file is a loud error, not a silent empty report
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1,2,3]")
+    with pytest.raises(ValueError):
+        mod.load_dump(str(bad))
+
+
+def test_trace_report_cli_smoke(tmp_path):
+    """The CLI entrypoint can't rot: run it as a real subprocess on a
+    synthetic dump (stdlib-only import path — no jax startup cost)."""
+    dump = _synthetic_dump(tmp_path / "trace.json")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(dump)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "3 request(s)" in out.stdout
+    out2 = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(dump), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out2.returncode == 0, out2.stderr
+    assert len(json.loads(out2.stdout)) == 3
+    # missing file exits 2 with a diagnostic on stderr
+    out3 = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(tmp_path / "nope.json")],
+        capture_output=True, text=True, timeout=60)
+    assert out3.returncode == 2 and "error:" in out3.stderr

@@ -321,3 +321,94 @@ def test_speculative_scheduler_stop_token():
     got = sched.submit([5, 7, 11], max_new_tokens=12, stop_token=stop)
     sched.run_until_done()
     assert got.output == want.output
+
+
+# -- tracing + instrument wiring (obs/trace.py, obs/registry.py) ------------
+
+def test_scheduler_trace_timeline():
+    """Every phase of a request's life shows up as span events with
+    monotonic timestamps; disabled tracing (the default) records
+    nothing and leaves sched.trace None."""
+    from butterfly_tpu.obs.trace import Tracer
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+    tr = Tracer()
+    sched = Scheduler(ServingEngine(model, params, rt), tracer=tr)
+    req = sched.submit([5, 7, 11], max_new_tokens=4,
+                       request_id="trace-me")
+    sched.run_until_done()
+    tl = tr.timeline(req.id)
+    assert tl["request_id"] == "trace-me"
+    names = [e["name"] for e in tl["events"]]
+    for needed in ("submit", "admit", "prefill_chunk", "prefill_done",
+                   "first_token", "finish"):
+        assert needed in names
+    ts = [e["t"] for e in tl["events"]]
+    assert ts == sorted(ts)
+    fin = tl["events"][-1]
+    assert fin["name"] == "finish" and fin["tokens"] == 4
+    # the global ring saw decode ticks and engine dispatches
+    globs = [e["name"] for e in tr.global_events()]
+    assert "decode_tick" in globs
+    assert "engine.prefill_dispatch" in globs
+
+    plain, _ = make_sched()
+    assert plain.trace is None  # default: no tracer, bare None check
+
+
+def test_scheduler_trace_preemption_events():
+    from butterfly_tpu.obs.trace import Tracer
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=32, page_size=4,
+                       num_pages=6)
+    tr = Tracer()
+    sched = Scheduler(ServingEngine(model, params, rt), tracer=tr)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=10)
+    r2 = sched.submit([3, 1], max_new_tokens=10)
+    sched.run_until_done(max_ticks=400)
+    assert sched.metrics()["preemptions_total"] > 0
+    preempted = r1 if r1.preemptions else r2
+    names = [e["name"] for e in tr.timeline(preempted.id)["events"]]
+    assert "preempt" in names
+    # readmission after the preempt is traced as a resumed admit
+    i = names.index("preempt")
+    admits = [e for e in tr.timeline(preempted.id)["events"][i:]
+              if e["name"] == "admit"]
+    assert admits and admits[0]["resumed"] is True
+
+
+def test_registry_histograms_observe_through_scheduler():
+    sched, _ = make_sched()
+    sched.submit([1, 2, 3], max_new_tokens=3)
+    sched.submit([4, 5], max_new_tokens=3)
+    sched.run_until_done()
+    reg = sched.registry
+    assert reg.get("ttft_seconds").count == 2
+    assert reg.get("queue_wait_seconds").count == 2
+    assert reg.get("prefill_tokens").count == 2
+    assert reg.get("itl_req_mean_seconds").count == 2
+    assert reg.get("batch_size").count >= 1
+    assert reg.get("requests_total").value == 2
+    # legacy dict view still mirrors the registry counters
+    m = sched.metrics()
+    assert m["requests_total"] == 2 and m["requests_finished"] == 2
+
+
+def test_written_counts_undrained_first_token():
+    """ADVICE.md r5 off-by-one: after prefill sampled the first token
+    on-device but before the stacked drain, every prompt token's K/V is
+    written — _written must not subtract one (it loses a page of
+    prefix-cache registration at page boundaries)."""
+    sched, _ = make_sched(max_batch=2, max_seq=64, page=8)
+    req = sched.submit([1] * 8, max_new_tokens=4)  # exactly one page
+    sched.tick()  # admit + prefill + on-device first sample (undrained)
+    assert req.state == "running" and req.output == []
+    assert any(f[0] is req for f in sched._pending_first)
+    assert sched._written(req) == 8  # the whole prompt, no -1
+    sched.tick()  # drain: first token lands on the host
+    assert len(req.output) >= 1
+    # once drained, the last sampled token's K/V is indeed unwritten
+    assert sched._written(req) == len(req.all_tokens) - 1
+    sched.run_until_done()

@@ -23,10 +23,11 @@ CFG = tiny("llama", dtype="float32", param_dtype="float32")
 @pytest.fixture(scope="module")
 def server():
     from http.server import ThreadingHTTPServer
+    from butterfly_tpu.obs.trace import Tracer
     model = Model(CFG)
     params = model.init(jax.random.PRNGKey(0))
     rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
-    sched = Scheduler(ServingEngine(model, params, rt))
+    sched = Scheduler(ServingEngine(model, params, rt), tracer=Tracer())
     state = ServerState(sched, ByteTokenizer())
     state.thread.start()
     httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
@@ -108,6 +109,77 @@ def test_metrics_endpoint(server):
     assert "butterfly_requests_total" in text
     assert "# TYPE butterfly_tokens_generated_total counter" in text
     assert "butterfly_kv_pages_free" in text
+
+
+def test_metrics_histograms_well_formed(server):
+    # at least one request must have completed for ttft to be observed
+    post(server, "/generate",
+         {"tokens": [2, 3], "max_tokens": 3, "stop_token": -1})
+    text = get(server, "/metrics")
+    assert "# TYPE butterfly_ttft_seconds histogram" in text
+    for name in ("ttft_seconds", "queue_wait_seconds", "batch_size",
+                 "prefill_tokens"):
+        full = f"butterfly_{name}"
+        buckets = [l for l in text.splitlines()
+                   if l.startswith(full + "_bucket")]
+        assert buckets, f"missing {full}_bucket series"
+        assert buckets[-1].startswith(full + '_bucket{le="+Inf"}')
+    # cumulative monotonicity + _count == +Inf bucket, per histogram
+    import re as _re
+    for name in ("ttft_seconds", "queue_wait_seconds"):
+        full = f"butterfly_{name}"
+        vals = [int(m.group(1)) for m in _re.finditer(
+            _re.escape(full) + r'_bucket\{le="[^"]+"\} (\d+)', text)]
+        assert vals == sorted(vals)
+        count = int(_re.search(
+            _re.escape(full) + r"_count (\d+)", text).group(1))
+        assert vals[-1] == count and count >= 1
+        assert _re.search(_re.escape(full) + r"_sum \d", text)
+    # a metric name never appears with two TYPE declarations
+    types = [l.split()[2] for l in text.splitlines()
+             if l.startswith("# TYPE")]
+    assert len(types) == len(set(types))
+
+
+def test_debug_requests_timeline(server):
+    # drive a STREAMED request with a client id, then read its timeline
+    resp = post(server, "/generate",
+                {"tokens": [4, 5, 6], "max_tokens": 4, "stream": True,
+                 "stop_token": -1, "request_id": "dbg-stream-1"}, raw=True)
+    for _ in resp:  # drain the SSE body to completion
+        pass
+    body = json.loads(get(server, "/debug/requests"))
+    assert body["enabled"] is True
+    mine = [r for r in body["requests"]
+            if r["request_id"] == "dbg-stream-1"]
+    assert len(mine) == 1
+    events = mine[0]["events"]
+    names = [e["name"] for e in events]
+    # acceptance: admit, prefill, first-token, finish present, in order
+    for needed in ("submit", "admit", "prefill_done", "first_token",
+                   "finish"):
+        assert needed in names, f"missing {needed} in {names}"
+    assert names.index("admit") < names.index("prefill_done") \
+        < names.index("first_token") < names.index("finish")
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts), "timestamps must be monotonic"
+    fin = events[names.index("finish")]
+    assert fin["state"] == "finished" and fin["tokens"] == 4
+    # ?n= limits the window
+    limited = json.loads(get(server, "/debug/requests?n=1"))
+    assert len(limited["requests"]) == 1
+
+
+def test_debug_requests_header_id_passthrough(server):
+    req = urllib.request.Request(
+        server + "/generate",
+        data=json.dumps({"tokens": [9], "max_tokens": 2,
+                         "stop_token": -1}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "hdr-77"})
+    json.loads(urllib.request.urlopen(req, timeout=120).read())
+    body = json.loads(get(server, "/debug/requests"))
+    assert any(r["request_id"] == "hdr-77" for r in body["requests"])
 
 
 def test_validation_errors(server):

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Render a per-request timeline report from a dumped trace.
+
+Input: the JSON a running server returns from ``GET /debug/requests``
+(or ``Tracer.dump_json``). Output: a per-request summary table (queue
+wait, prefill, TTFT, decode, totals) and, with ``--timeline ID``, the
+full event list for one request with inter-event deltas — the "where
+did this request's time go" view.
+
+    curl -s localhost:8000/debug/requests > trace.json
+    python tools/trace_report.py trace.json
+    python tools/trace_report.py trace.json --timeline 17
+
+stdlib-only on purpose: runs anywhere the dump lands (laptop, CI), no
+jax / no backend required.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _summarize_timeline():
+    """Resolve obs.trace.summarize_timeline WITHOUT importing the
+    butterfly_tpu package root (which drags in jax): the trace module is
+    stdlib-only, so a checkout loads it straight from its file. Falls
+    back to the package import for installed layouts."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "butterfly_tpu", "obs", "trace.py")
+    if os.path.exists(path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_bt_obs_trace", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.summarize_timeline
+    from butterfly_tpu.obs.trace import summarize_timeline
+    return summarize_timeline
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    return f"{v * 1e3:.1f}ms"
+
+
+def _fmt(v: Any) -> str:
+    return "-" if v is None else str(v)
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or "requests" not in dump:
+        raise ValueError(
+            f"{path}: not a trace dump (expected a JSON object with a "
+            f"'requests' key — the GET /debug/requests body)")
+    return dump
+
+
+def summary_rows(dump: Dict[str, Any]) -> List[Dict[str, Any]]:
+    summarize = _summarize_timeline()
+    return [summarize(rec) for rec in dump.get("requests", ())]
+
+
+def render_summary(dump: Dict[str, Any]) -> str:
+    rows = summary_rows(dump)
+    cols = [("id", 5), ("request_id", 14), ("state", 9), ("queue", 8),
+            ("prefill", 8), ("ttft", 8), ("decode", 8), ("total", 8),
+            ("toks", 5), ("chunks", 6), ("preempt", 7)]
+    out = [" ".join(f"{name:>{w}}" for name, w in cols)]
+    for r in rows:
+        vals = [_fmt(r["id"]), _fmt(r["request_id"])[:14], _fmt(r["state"]),
+                _fmt_s(r["queue_wait_s"]), _fmt_s(r["prefill_s"]),
+                _fmt_s(r["ttft_s"]), _fmt_s(r["decode_s"]),
+                _fmt_s(r["total_s"]), _fmt(r["tokens"]),
+                _fmt(r["prefill_chunks"]), _fmt(r["preemptions"])]
+        out.append(" ".join(f"{v:>{w}}" for v, (_, w) in zip(vals, cols)))
+    done = [r for r in rows if r["total_s"] is not None]
+    out.append("")
+    out.append(f"{len(rows)} request(s), {len(done)} with a complete "
+               f"submit->finish timeline")
+    if done:
+        ttfts = sorted(r["ttft_s"] for r in done
+                       if r["ttft_s"] is not None)
+        if ttfts:
+            out.append(
+                f"ttft: min {_fmt_s(ttfts[0])}  "
+                f"p50 {_fmt_s(ttfts[len(ttfts) // 2])}  "
+                f"max {_fmt_s(ttfts[-1])}")
+    n_glob = len(dump.get("global_events", ()))
+    if n_glob:
+        ticks = sum(1 for ev in dump["global_events"]
+                    if ev.get("name") == "decode_tick")
+        out.append(f"{n_glob} global event(s), {ticks} decode tick(s)")
+    return "\n".join(out)
+
+
+def render_timeline(dump: Dict[str, Any], rid: int) -> str:
+    rec = next((r for r in dump.get("requests", ())
+                if r.get("id") == rid), None)
+    if rec is None:
+        raise ValueError(f"no request with id {rid} in the dump "
+                         f"(have: {[r.get('id') for r in dump['requests']]})")
+    events = rec.get("events", [])
+    out = [f"request {rid}"
+           + (f" (request_id={rec['request_id']})"
+              if rec.get("request_id") else "")]
+    t0 = events[0]["t"] if events else 0.0
+    prev = t0
+    for ev in events:
+        t = ev["t"]
+        attrs = " ".join(f"{k}={v}" for k, v in ev.items()
+                         if k not in ("t", "name"))
+        out.append(f"  +{t - t0:9.4f}s (Δ{_fmt_s(t - prev)}) "
+                   f"{ev['name']:<14} {attrs}")
+        prev = t
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_report",
+        description="summarize a /debug/requests trace dump")
+    p.add_argument("dump", help="path to the JSON trace dump")
+    p.add_argument("--timeline", type=int, default=None, metavar="ID",
+                   help="print one request's full event timeline")
+    p.add_argument("--json", action="store_true",
+                   help="emit the per-request summaries as JSON instead "
+                        "of a table")
+    args = p.parse_args(argv)
+    try:
+        dump = load_dump(args.dump)
+        if args.timeline is not None:
+            print(render_timeline(dump, args.timeline))
+        elif args.json:
+            print(json.dumps(summary_rows(dump)))
+        else:
+            print(render_summary(dump))
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
