@@ -39,6 +39,37 @@ from typing import Dict, List, Optional, Set
 from butterfly_tpu.cache.allocator import PageAllocator
 
 
+def chain_block_hashes(tokens: List[int], page_size: int,
+                       max_pages: Optional[int] = None) -> List[bytes]:
+    """SHA-256 chain digests, one per FULL page-sized block of `tokens`.
+
+    Block i's digest commits to all tokens of blocks 0..i, so equality of
+    digest i implies the whole leading prefix matches. Cryptographic, NOT
+    Python hash(): token ids are client-controlled (/generate accepts raw
+    id lists), and a constructible collision would silently alias another
+    prefix — in the allocator that means attaching another request's K/V
+    pages (cross-request output leakage), in the router it means
+    steerable affinity placement.
+
+    Shared by PrefixCachingAllocator (page registry keys) and
+    router/policy.py (prefix-affinity routing keys): both layers hashing
+    the same blocks the same way is what makes router affinity line up
+    with where cached pages actually live.
+    """
+    ps = page_size
+    n = len(tokens) // ps
+    if max_pages is not None:
+        n = min(n, max_pages)
+    hashes: List[bytes] = []
+    h = b""
+    for i in range(n):
+        m = hashlib.sha256(h)
+        m.update(b",".join(b"%d" % t for t in tokens[i * ps:(i + 1) * ps]))
+        h = m.digest()
+        hashes.append(h)
+    return hashes
+
+
 class PrefixCachingAllocator(PageAllocator):
     """PageAllocator plus content-hash prefix reuse.
 
@@ -68,22 +99,8 @@ class PrefixCachingAllocator(PageAllocator):
     # -- registry internals --------------------------------------------------
 
     def _chain_hashes(self, tokens: List[int], max_pages: int) -> List[bytes]:
-        """SHA-256 chain digests, one per full page. Page i's digest
-        commits to all tokens of pages 0..i, so a registry hit implies
-        the whole prefix matches. Cryptographic, NOT Python hash():
-        token ids are client-controlled (/generate accepts raw id
-        lists), and a constructible collision would silently attach
-        another request's K/V pages — cross-request output leakage."""
-        ps = self.page_size
-        hashes: List[bytes] = []
-        h = b""
-        for i in range(min(len(tokens) // ps, max_pages)):
-            m = hashlib.sha256(h)
-            m.update(b",".join(b"%d" % t for t in
-                               tokens[i * ps:(i + 1) * ps]))
-            h = m.digest()
-            hashes.append(h)
-        return hashes
+        """Registry keys: the shared chain_block_hashes at page size."""
+        return chain_block_hashes(tokens, self.page_size, max_pages)
 
     def _evict_one(self) -> None:
         pid, _ = self._evictable.popitem(last=False)  # oldest first

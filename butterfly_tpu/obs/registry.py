@@ -191,6 +191,65 @@ class Histogram:
         return out
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class LabeledFamily:
+    """A family of Counter/Gauge children keyed by label values.
+
+    ``labels(...)`` get-or-creates the child for one label-value tuple;
+    the child is a plain Counter/Gauge (same single-writer contract), and
+    the family renders HELP/TYPE once followed by every child as a
+    ``name{label="value",...}`` series. Children are never retired — the
+    router's label sets (replica id x outcome) are small and fixed, so a
+    long-lived process can't leak series without leaking replicas.
+    """
+
+    __slots__ = ("cls", "name", "help", "labelnames", "_children", "_lock")
+
+    def __init__(self, cls, name: str, help: str,
+                 labelnames: Sequence[str]):
+        self.cls = cls
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        if not self.labelnames:
+            raise ValueError(f"family {name} needs at least one label")
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values):
+        vals = tuple(str(v) for v in values)
+        if len(vals) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, "
+                f"got {len(vals)} values")
+        with self._lock:
+            child = self._children.get(vals)
+            if child is None:
+                child = self.cls(self.name)
+                self._children[vals] = child
+            return child
+
+    def render(self, prefix: str) -> List[str]:
+        full = f"{prefix}_{self.name}" if prefix else self.name
+        with self._lock:
+            items = sorted(self._children.items())
+        out = []
+        if self.help:
+            out.append(f"# HELP {full} {self.help}")
+        kind = "counter" if self.cls is Counter else "gauge"
+        out.append(f"# TYPE {full} {kind}")
+        for vals, child in items:
+            lbl = ",".join(f'{n}="{_escape_label(v)}"'
+                           for n, v in zip(self.labelnames, vals))
+            out.append(f"{full}{{{lbl}}} {_fmt(child.value)}")
+        return out
+
+
 class MetricsRegistry:
     """Named instrument registry with idempotent get-or-create.
 
@@ -227,6 +286,29 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def _family(self, cls, name: str, help: str,
+                labelnames: Sequence[str]) -> LabeledFamily:
+        name = sanitize_name(name)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = LabeledFamily(cls, name, help, labelnames)
+                self._instruments[name] = inst
+            elif not (isinstance(inst, LabeledFamily) and inst.cls is cls
+                      and inst.labelnames == tuple(labelnames)):
+                raise ValueError(
+                    f"metric {name!r} already registered with a different "
+                    f"type or label set")
+            return inst
+
+    def counter_family(self, name: str, help: str = "",
+                       labelnames: Sequence[str] = ()) -> LabeledFamily:
+        return self._family(Counter, name, help, labelnames)
+
+    def gauge_family(self, name: str, help: str = "",
+                     labelnames: Sequence[str] = ()) -> LabeledFamily:
+        return self._family(Gauge, name, help, labelnames)
 
     def names(self) -> Iterable[str]:
         with self._lock:

@@ -1,0 +1,286 @@
+"""Replica pool: health-aware membership for the multi-replica router.
+
+Each backend `butterfly serve` replica is tracked as a `Replica` with a
+liveness state plus an orthogonal admin `drain` flag:
+
+* ``live``      last probe returned 200 — routable.
+* ``degraded``  reachable-but-unhealthy (a wedged replica's 503) or a
+                fresh connection failure below the dead threshold —
+                excluded from routing, re-probed at the normal cadence.
+* ``dead``      >= `dead_after` consecutive connection failures — re-
+                probed with jittered exponential backoff so a downed
+                host isn't hammered, and a restarted one is found within
+                `backoff_max`.
+* ``draining``  admin-requested (POST /router/drain): no NEW requests
+                route to it, in-flight ones finish; probing continues so
+                an undrain returns it at its true liveness.
+
+The prober is one daemon thread issuing `GET /health` per due replica
+(serve/server.py answers it without taking the scheduler lock, so a
+busy replica still probes fast). The 200 body carries `queue_depth` and
+`active` — the load signal the least-loaded policy reads — so the
+router never scrapes full Prometheus text on the request path.
+
+Proxy feedback short-circuits the prober: a connection-refused or
+wedged-503 observed while forwarding marks the replica immediately, so
+the very next request skips it instead of waiting out a probe cycle.
+
+stdlib-only; thread-safe (one lock around membership state — probe I/O
+happens outside it).
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+LIVE = "live"
+DEGRADED = "degraded"
+DEAD = "dead"
+DRAINING = "draining"
+
+
+class Replica:
+    """One backend's membership record. Mutated only under the pool lock."""
+
+    __slots__ = ("rid", "host", "port", "liveness", "drain", "outstanding",
+                 "queue_depth", "active", "fails", "probes", "last_probe_t",
+                 "next_probe_t", "last_error")
+
+    def __init__(self, rid: str, host: str, port: int):
+        self.rid = rid
+        self.host = host
+        self.port = port
+        # optimistic start: routable until a probe (or proxy feedback)
+        # says otherwise — the router must not 503 a healthy fleet just
+        # because the first probe round hasn't completed yet
+        self.liveness = LIVE
+        self.drain = False
+        self.outstanding = 0     # router-tracked in-flight proxied requests
+        self.queue_depth = 0     # from the last /health scrape
+        self.active = 0          # from the last /health scrape
+        self.fails = 0           # consecutive probe/connect failures
+        self.probes = 0
+        self.last_probe_t: Optional[float] = None
+        self.next_probe_t = 0.0  # due immediately
+        self.last_error = ""
+
+    @property
+    def state(self) -> str:
+        """Reported state: the admin drain flag masks liveness."""
+        return DRAINING if self.drain else self.liveness
+
+    @property
+    def routable(self) -> bool:
+        return self.liveness == LIVE and not self.drain
+
+    def load_score(self):
+        """Ordering key for least-loaded fallback: router-tracked
+        outstanding first (always fresh), then the replica's own scraped
+        backlog, then rid for determinism."""
+        return (self.outstanding, self.queue_depth + self.active, self.rid)
+
+    def snapshot(self) -> dict:
+        return {"replica": self.rid, "state": self.state,
+                "outstanding": self.outstanding,
+                "queue_depth": self.queue_depth, "active": self.active,
+                "consecutive_failures": self.fails,
+                "probes": self.probes, "last_error": self.last_error}
+
+
+def parse_backend(spec: str) -> tuple:
+    """'host:port' -> (host, port); bare ':port'/'port' default host."""
+    spec = spec.strip()
+    if ":" in spec:
+        host, _, port = spec.rpartition(":")
+        host = host or "127.0.0.1"
+    else:
+        host, port = "127.0.0.1", spec
+    return host, int(port)
+
+
+class ReplicaPool:
+    def __init__(self, backends: List[str], probe_interval: float = 0.5,
+                 probe_timeout: float = 2.0, dead_after: int = 3,
+                 backoff_base: float = 0.5, backoff_max: float = 10.0,
+                 registry=None):
+        if not backends:
+            raise ValueError("router needs at least one backend")
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.dead_after = dead_after
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.replicas: Dict[str, Replica] = {}
+        for spec in backends:
+            host, port = parse_backend(spec)
+            rid = f"{host}:{port}"
+            if rid in self.replicas:
+                raise ValueError(f"duplicate backend {rid}")
+            self.replicas[rid] = Replica(rid, host, port)
+        # per-replica outstanding gauge on the router's own registry
+        self._g_out = None
+        if registry is not None:
+            self._g_out = registry.gauge_family(
+                "router_outstanding_requests",
+                "Requests currently proxied to each replica", ("replica",))
+            for rid in self.replicas:
+                self._g_out.labels(rid).set(0)
+
+    # -- membership queries --------------------------------------------------
+
+    def get(self, rid: str) -> Optional[Replica]:
+        return self.replicas.get(rid)
+
+    def routable(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas.values() if r.routable]
+
+    def candidates(self) -> List[Replica]:
+        """Replicas worth attempting, best liveness first: routable ones,
+        else (all degraded — e.g. one connect blip marked the only
+        replica before its re-probe) the degraded ones as a last resort.
+        Dead and draining members are never returned — dead is the
+        pool's signal the proxy must not waste a connect on it."""
+        with self._lock:
+            live = [r for r in self.replicas.values() if r.routable]
+            if live:
+                return live
+            return [r for r in self.replicas.values()
+                    if r.liveness == DEGRADED and not r.drain]
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [r.snapshot() for r in self.replicas.values()]
+
+    # -- proxy feedback ------------------------------------------------------
+
+    def note_dispatch(self, rid: str) -> None:
+        with self._lock:
+            r = self.replicas[rid]
+            r.outstanding += 1
+            if self._g_out is not None:
+                self._g_out.labels(rid).set(r.outstanding)
+
+    def note_done(self, rid: str) -> None:
+        with self._lock:
+            r = self.replicas[rid]
+            r.outstanding = max(0, r.outstanding - 1)
+            if self._g_out is not None:
+                self._g_out.labels(rid).set(r.outstanding)
+
+    def note_connect_failure(self, rid: str, err: str = "") -> None:
+        """Proxy saw a refused/reset connect: count it toward dead and
+        stop routing there now — don't wait for the next probe cycle."""
+        with self._lock:
+            self._fail(self.replicas[rid], err or "connect failed",
+                       time.monotonic())
+
+    def note_wedged(self, rid: str, err: str = "") -> None:
+        """Proxy saw a wedged-503: reachable but unhealthy. Degrade
+        without advancing toward dead (the process is up; its prober
+        probe will flip it back the moment /health recovers)."""
+        with self._lock:
+            r = self.replicas[rid]
+            if r.liveness == LIVE:
+                r.liveness = DEGRADED
+            r.last_error = err or "503 from replica"
+
+    # -- admin ---------------------------------------------------------------
+
+    def set_drain(self, rid: str, draining: bool) -> Optional[dict]:
+        with self._lock:
+            r = self.replicas.get(rid)
+            if r is None:
+                return None
+            r.drain = draining
+            return r.snapshot()
+
+    # -- probing -------------------------------------------------------------
+
+    def probe_one(self, r: Replica) -> None:
+        """Synchronous probe of one replica; state applied under the
+        lock, network I/O outside it."""
+        url = f"http://{r.host}:{r.port}/health"
+        now = time.monotonic()
+        try:
+            with urllib.request.urlopen(url,
+                                        timeout=self.probe_timeout) as resp:
+                body = json.loads(resp.read() or b"{}")
+            ok, detail = True, body
+        except urllib.error.HTTPError as e:  # reachable, unhealthy (503)
+            ok, detail = False, f"http {e.code}"
+            e.close()
+        except Exception as e:  # refused / timeout / reset / bad JSON
+            ok, detail = None, f"{type(e).__name__}: {e}"
+        with self._lock:
+            r.probes += 1
+            r.last_probe_t = now
+            if ok:
+                r.liveness = LIVE
+                r.fails = 0
+                r.last_error = ""
+                r.queue_depth = int(detail.get("queue_depth", 0) or 0)
+                r.active = int(detail.get("active", 0) or 0)
+                r.next_probe_t = now + self.probe_interval
+            elif ok is False:  # wedged: degraded, normal re-probe cadence
+                r.liveness = DEGRADED
+                r.last_error = detail
+                r.next_probe_t = now + self.probe_interval
+            else:
+                self._fail(r, detail, now)
+
+    def _fail(self, r: Replica, err: str, now: float) -> None:
+        """Shared connect-failure accounting (lock held): escalate
+        degraded -> dead and schedule the jittered-backoff re-probe."""
+        r.fails += 1
+        r.last_error = err
+        if r.fails >= self.dead_after:
+            r.liveness = DEAD
+            # jittered exponential backoff: doubling from the threshold,
+            # capped, x[0.5, 1.5) jitter so a fleet of routers doesn't
+            # re-probe a recovering host in lockstep
+            delay = min(self.backoff_max,
+                        self.backoff_base
+                        * 2 ** min(r.fails - self.dead_after, 20))
+            r.next_probe_t = now + delay * (0.5 + random.random())
+        else:
+            r.liveness = DEGRADED
+            r.next_probe_t = now + self.probe_interval
+
+    def probe_due(self) -> int:
+        """Probe every replica whose next_probe_t has passed. Returns how
+        many were probed (tests drive this synchronously)."""
+        now = time.monotonic()
+        with self._lock:
+            due = [r for r in self.replicas.values()
+                   if r.next_probe_t <= now]
+        for r in due:
+            self.probe_one(r)
+        return len(due)
+
+    def probe_all(self) -> None:
+        for r in list(self.replicas.values()):
+            self.probe_one(r)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.probe_due()
+            self._stop.wait(self.probe_interval / 2)
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

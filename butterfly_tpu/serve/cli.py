@@ -5,6 +5,7 @@
     butterfly generate --model gpt2-124m --prompt "hello" --max-new 32
     butterfly serve    --model llama3-8b --port 8000
     butterfly bench    --model tiny
+    butterfly route    --backends 10.0.0.1:8000,10.0.0.2:8000
 
 Models load from --ckpt (HF safetensors dir or our sharded checkpoint);
 without --ckpt, weights are random-initialized (smoke/demo mode).
@@ -123,6 +124,39 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--batch", type=int, default=8)
     b.add_argument("--prompt-len", type=int, default=128)
     b.add_argument("--max-new", type=int, default=128)
+
+    # multi-replica router: fronts N `butterfly serve` replicas with
+    # prefix-affinity routing + health-aware failover (router/). Loads no
+    # model and touches no accelerator — deliberately NOT given the
+    # common() model/mesh flags.
+    r = sub.add_parser("route",
+                       help="route requests across serve replicas "
+                            "(prefix-affinity + health-aware failover)")
+    r.add_argument("--backends", required=True,
+                   help="comma-separated replica addresses, e.g. "
+                        "10.0.0.1:8000,10.0.0.2:8000")
+    r.add_argument("--port", type=int, default=8100)
+    r.add_argument("--host", default="0.0.0.0")
+    r.add_argument("--page-size", type=int, default=16,
+                   help="MUST match the replicas' --page-size: affinity "
+                        "keys hash the same token blocks their prefix "
+                        "caches key pages by")
+    r.add_argument("--affinity-blocks", type=int, default=4,
+                   help="leading full prompt blocks hashed into the "
+                        "affinity key (requests agreeing on this many "
+                        "blocks share a replica)")
+    r.add_argument("--saturate-after", type=int, default=8,
+                   help="outstanding requests at which the affinity "
+                        "target is considered saturated and routing "
+                        "falls back to least-outstanding")
+    r.add_argument("--probe-interval", type=float, default=0.5,
+                   help="seconds between /health probes of each replica")
+    r.add_argument("--dead-after", type=int, default=3,
+                   help="consecutive connect failures before a replica "
+                        "is marked dead (re-probed with jittered "
+                        "exponential backoff)")
+    r.add_argument("--read-timeout", type=float, default=300.0,
+                   help="per-request socket timeout toward a replica")
     return p
 
 
@@ -308,10 +342,22 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_route(args) -> int:
+    from butterfly_tpu.router.proxy import route_forever
+    backends = [b for b in args.backends.split(",") if b.strip()]
+    return route_forever(backends, host=args.host, port=args.port,
+                         page_size=args.page_size,
+                         affinity_blocks=args.affinity_blocks,
+                         saturate_after=args.saturate_after,
+                         probe_interval=args.probe_interval,
+                         dead_after=args.dead_after,
+                         read_timeout=args.read_timeout)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"generate": cmd_generate, "serve": cmd_serve,
-            "bench": cmd_bench}[args.cmd](args)
+            "bench": cmd_bench, "route": cmd_route}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -17,12 +17,17 @@ zero-egress environment):
   "finish_reason"}], "usage"}; streaming sends OpenAI-style SSE chunks.
 * GET /metrics    Prometheus text (obs/metrics.py + the typed registry's
   histogram series — obs/registry.py)
-* GET /health     {"status": "ok"}
+* GET /health     {"status": "ok", "queue_depth": N, "active": M} — one
+  cheap JSON probe carrying the load signal the multi-replica router's
+  least-loaded policy reads (no Prometheus text scrape needed); 503
+  with a detail string when wedged.
 * GET /debug/requests[?n=K]   recent per-request trace timelines as JSON
   (obs/trace.py; requires the scheduler to be built with a Tracer —
   returns {"enabled": false} otherwise). Clients may tag requests with
   an `X-Request-Id` header or a `request_id` body field; the id rides
-  the trace verbatim so client logs join server timelines.
+  the trace verbatim so client logs join server timelines, and is
+  echoed back as an `X-Request-Id` response header on every response
+  (JSON and SSE) so clients/routers correlate without parsing bodies.
 
 One scheduler thread owns all device work (ticks); HTTP handler threads
 only enqueue requests and wait on per-request queues — JAX never runs on
@@ -243,21 +248,42 @@ def make_handler(state: ServerState):
         def log_message(self, fmt, *args):  # quiet
             pass
 
-        def _json(self, code: int, obj) -> None:
+        # client correlation id for the in-flight request: set from the
+        # X-Request-Id header at dispatch, refined by _parse_request when
+        # the id arrives as a body field instead. Echoed back as a
+        # response header on every response (JSON and SSE) so clients —
+        # and the multi-replica router — can correlate without parsing
+        # bodies.
+        _rid: Optional[str] = None
+
+        def _json(self, code: int, obj, headers=None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
+            self._rid = self._header_rid()
             if self.path == "/health":
                 if state.error:  # incl. heartbeat latch (on_failure sets it)
                     self._json(503, {"status": "error",
                                      "detail": state.error})
                 else:
-                    body = {"status": "ok"}
+                    # queue_depth/active are deliberately read WITHOUT
+                    # state.lock: len() on the scheduler's deque/list is
+                    # atomic enough for a load probe (one update stale at
+                    # worst), and /health must stay responsive even when
+                    # a slow tick holds the lock — the router's prober
+                    # times out a hanging probe into "degraded".
+                    body = {"status": "ok",
+                            "queue_depth": len(state.sched.waiting),
+                            "active": len(state.sched._all_live)}
                     if state.heartbeat is not None:
                         body["heartbeats"] = state.heartbeat.beats
                     self._json(200, body)
@@ -274,6 +300,10 @@ def make_handler(state: ServerState):
             else:
                 self._json(404, {"error": "not found"})
 
+        def _header_rid(self) -> Optional[str]:
+            rid = self.headers.get("X-Request-Id")
+            return str(rid)[:128] if rid is not None else None
+
         def _query_n(self):
             """?n=K limit for /debug/requests; None when absent/bad."""
             from urllib.parse import parse_qs, urlparse
@@ -284,6 +314,7 @@ def make_handler(state: ServerState):
                 return None
 
         def do_POST(self):
+            self._rid = self._header_rid()
             if self.path == "/generate":
                 self._handle_generate()
             elif self.path == "/v1/completions":
@@ -333,18 +364,21 @@ def make_handler(state: ServerState):
             rid = self.headers.get("X-Request-Id") \
                 or body.get("request_id")
             rid = str(rid)[:128] if rid is not None else None
+            self._rid = rid  # echoed on the response (incl. SSE headers)
             return tokens, max_tokens, temperature, stop, rid
 
         def _admit(self, body: dict, openai: bool = False):
             """Parse + submit; handles every error response (in the
             OpenAI error-envelope shape when `openai`). Returns
             (req, queue) or None if a response was already sent."""
-            def err(code: int, msg: str, etype: str) -> None:
+            def err(code: int, msg: str, etype: str,
+                    headers=None) -> None:
                 if openai:
                     self._json(code, {"error": {"message": msg,
-                                                "type": etype}})
+                                                "type": etype}},
+                               headers=headers)
                 else:
-                    self._json(code, {"error": msg})
+                    self._json(code, {"error": msg}, headers=headers)
 
             try:
                 tokens, max_tokens, temperature, stop, rid = \
@@ -365,7 +399,11 @@ def make_handler(state: ServerState):
                 err(503, str(e), "server_error")
                 return None
             if req is None:
-                err(429, "queue full", "rate_limit_error")
+                # explicit backoff signal: the router (and well-behaved
+                # clients) should stop hammering a saturated replica
+                # instead of retry-spinning on 429s
+                err(429, "queue full", "rate_limit_error",
+                    headers={"Retry-After": "1"})
                 return None
             return req, q
 
@@ -531,6 +569,8 @@ def make_handler(state: ServerState):
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
 
             def chunk(data: bytes) -> None:

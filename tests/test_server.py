@@ -51,7 +51,12 @@ def get(url, path):
 
 
 def test_health(server):
-    assert json.loads(get(server, "/health")) == {"status": "ok"}
+    body = json.loads(get(server, "/health"))
+    assert body["status"] == "ok"
+    # load signal for the router's least-loaded policy: one cheap JSON
+    # probe instead of a Prometheus text scrape
+    assert isinstance(body["queue_depth"], int) and body["queue_depth"] >= 0
+    assert isinstance(body["active"], int) and body["active"] >= 0
 
 
 def test_generate_blocking(server):

@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""Closed-loop multi-client load generator for a serve replica or router.
+
+stdlib-only (urllib + threading — no jax, no backend): each of
+``--clients`` worker threads keeps exactly ONE request in flight (issue,
+wait for the full response, repeat), the closed-loop shape that exercises
+continuous batching without open-loop queue explosion.
+
+``--prefix-share R`` is the affinity workload knob: fraction of requests
+whose token prompt begins with a SHARED ``--shared-len``-token prefix
+(the "same system prompt" population). Pointed at a router, a high share
+should concentrate those requests on one replica and raise its
+prefix-cache hit counters; pointed straight at a replica it measures
+prefix-caching TTFT wins.
+
+Importable by tests (``run_load``) and runnable standalone:
+
+    python tools/loadgen.py --url http://127.0.0.1:8100 \
+        --clients 8 --requests 16 --prefix-share 0.5 --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _percentile(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    k = min(len(s) - 1, max(0, int(round(p / 100 * (len(s) - 1)))))
+    return s[k]
+
+
+def shared_prefix(shared_len: int, seed: int = 0,
+                  vocab: int = 64) -> List[int]:
+    """The deterministic shared-prefix token block (page-aligned lengths
+    make it land whole pages in the replicas' prefix caches)."""
+    rng = random.Random(10_000 + seed)
+    return [rng.randrange(1, vocab) for _ in range(shared_len)]
+
+
+def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
+             prefix_share: float = 0.5, shared_len: int = 32,
+             tail_len: int = 8, max_tokens: int = 8, seed: int = 0,
+             vocab: int = 64, path: str = "/generate",
+             timeout: float = 120.0) -> Dict:
+    """Drive `url` closed-loop; returns aggregate stats.
+
+    Every request uses token-id prompts (deterministic, tokenizer-free).
+    A `prefix_share` fraction starts with the shared prefix plus a
+    per-request tail; the rest are fully private prompts of the same
+    total length, so the two populations differ only in shareability.
+    """
+    prefix = shared_prefix(shared_len, seed, vocab)
+    lock = threading.Lock()
+    latencies: List[float] = []
+    shared_latencies: List[float] = []
+    by_replica: Dict[str, int] = {}
+    errors: List[str] = []
+    counts = {"sent": 0, "ok": 0, "shared": 0}
+
+    def one_client(cid: int) -> None:
+        rng = random.Random(seed * 1000 + cid)
+        for i in range(requests_per_client):
+            is_shared = rng.random() < prefix_share
+            tail = [rng.randrange(1, vocab) for _ in range(tail_len)]
+            tokens = (prefix + tail) if is_shared else \
+                [rng.randrange(1, vocab)
+                 for _ in range(shared_len + tail_len)]
+            body = json.dumps({
+                "tokens": tokens, "max_tokens": max_tokens,
+                "stop_token": -1,
+                "request_id": f"loadgen-{cid}-{i}"}).encode()
+            req = urllib.request.Request(
+                url + path, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    resp.read()
+                    routed = resp.headers.get("X-Routed-To")
+                dt = time.monotonic() - t0
+                with lock:
+                    counts["sent"] += 1
+                    counts["ok"] += 1
+                    counts["shared"] += int(is_shared)
+                    latencies.append(dt)
+                    if is_shared:
+                        shared_latencies.append(dt)
+                    if routed:
+                        by_replica[routed] = by_replica.get(routed, 0) + 1
+            except (urllib.error.URLError, OSError) as e:
+                with lock:
+                    counts["sent"] += 1
+                    errors.append(f"client{cid}#{i}: {e}")
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=one_client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    return {
+        "sent": counts["sent"], "ok": counts["ok"],
+        "failed": counts["sent"] - counts["ok"],
+        "shared_prefix_requests": counts["shared"],
+        "wall_s": wall,
+        "rps": counts["ok"] / wall if wall > 0 else 0.0,
+        "latency_p50_s": _percentile(latencies, 50),
+        "latency_p95_s": _percentile(latencies, 95),
+        "shared_latency_p50_s": _percentile(shared_latencies, 50),
+        "by_replica": by_replica,
+        "errors": errors[:20],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="closed-loop load generator for butterfly serve/route")
+    ap.add_argument("--url", required=True,
+                    help="base URL, e.g. http://127.0.0.1:8100")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per client")
+    ap.add_argument("--prefix-share", type=float, default=0.5)
+    ap.add_argument("--shared-len", type=int, default=32)
+    ap.add_argument("--tail-len", type=int, default=8)
+    ap.add_argument("--max-tokens", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--path", default="/generate")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    stats = run_load(args.url, clients=args.clients,
+                     requests_per_client=args.requests,
+                     prefix_share=args.prefix_share,
+                     shared_len=args.shared_len, tail_len=args.tail_len,
+                     max_tokens=args.max_tokens, seed=args.seed,
+                     path=args.path)
+    if args.json:
+        print(json.dumps(stats, indent=2))
+    else:
+        print(f"sent={stats['sent']} ok={stats['ok']} "
+              f"failed={stats['failed']} rps={stats['rps']:.2f}")
+        print(f"latency p50={stats['latency_p50_s'] * 1e3:.1f}ms "
+              f"p95={stats['latency_p95_s'] * 1e3:.1f}ms")
+        if stats["by_replica"]:
+            print("by replica: " + ", ".join(
+                f"{rid}={n}" for rid, n in
+                sorted(stats["by_replica"].items())))
+        for e in stats["errors"]:
+            print(f"error: {e}", file=sys.stderr)
+    return 0 if stats["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
