@@ -36,10 +36,12 @@ def main() -> int:
         # fit one v5e chip's 16 GiB HBM with the int8 KV cache.
         cfg = llama3_8b().replace(max_seq_len=2048)
         batch, prompt_len, max_new = 128, 128, 128
-        # decode_steps_per_tick=16: the scheduler chains 16 decode steps
-        # device-side per tick with ONE stacked token fetch — the dev
-        # tunnel's ~100 ms dispatch+fetch RTT would otherwise dominate
-        # every per-token readback (scheduler._inflight docs).
+        # decode_steps_per_tick=16: each tick runs 16 decode iterations
+        # as ONE fused jitted scan (engine._decode_scan) — one dispatch
+        # and one stacked token fetch per tick, so the per-token host
+        # work (dispatch, operand conversion, RNG split) is paid once
+        # per block; the dev tunnel's ~100 ms dispatch+fetch RTT would
+        # otherwise dominate every per-token readback.
         serving_kw = dict(n_requests=64, prompt_len=128, max_new=128,
                           max_batch=32, decode_steps_per_tick=16)
         baseline_key = "tpu_8b"
@@ -47,7 +49,7 @@ def main() -> int:
         cfg = tiny("llama", dtype="float32", param_dtype="float32")
         batch, prompt_len, max_new = 4, 32, 32
         serving_kw = dict(n_requests=6, prompt_len=16, max_new=8,
-                          max_batch=4)
+                          max_batch=4, decode_steps_per_tick=4)
         baseline_key = "cpu"
 
     model = Model(cfg)

@@ -187,7 +187,11 @@ class RuntimeConfig:
     scheduler: str = "continuous"     # "continuous" (chunked-prefill/decode
                                       # interleave) | "static" (drain batches)
     max_queue: int = 256
-    decode_steps_per_tick: int = 1    # decode steps run per tick()
+    decode_steps_per_tick: int = 1    # fused decode block width: the
+                                      # scheduler runs this many decode
+                                      # iterations per tick() inside ONE
+                                      # jitted scan (one dispatch + one
+                                      # stacked drain per tick)
     prefix_caching: bool = False      # content-hash KV page reuse across
                                       # requests (cache/prefix.py): shared
                                       # prompt prefixes skip prefill entirely

@@ -12,6 +12,12 @@ recompile (SURVEY.md §7 "hard parts"):
   masked via `active` (their lengths don't advance, their writes land on
   the null page). Sampling is vectorized with per-slot temperature so
   requests with different sampling settings batch together.
+* `decode_block`: k chained decode iterations inside ONE jitted
+  `lax.scan` (`_decode_scan`) — one host dispatch and one stacked fetch
+  per scheduler tick instead of k. Per-step RNG keys are derived on
+  device (`fold_in`), and per-slot stop ids + remaining-token budgets
+  ride the carry so a slot that finishes mid-block goes dead on device
+  (no further writes, no length growth, frozen tokens).
 
 Parity contract: tests/test_sched.py and tests/test_serving_mesh.py check
 token-for-token equality with InferenceEngine.generate on the contiguous
@@ -20,22 +26,35 @@ cache (single-device and meshed respectively).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from butterfly_tpu.cache.paged import (
     PagedKVCache, init_paged_cache, paged_forward)
 from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
+from butterfly_tpu.engine.sampling import _apply_top_k, _apply_top_p
 from butterfly_tpu.models.common import Model
 
 
-def bucket_len(n: int, lo: int = 16) -> int:
+def bucket_len(n: int, lo: int = 16, hi: Optional[int] = None) -> int:
+    """Next power-of-two bucket >= n (floor lo), clamped to hi.
+
+    The clamp keeps an over-long chunk from requesting a prefill
+    program wider than the cache supports (positions past the table
+    row would silently pad to the null page while the mask/gather view
+    stays cache-wide); n > hi is a caller bug and raises."""
+    if hi is not None and n > hi:
+        raise ValueError(f"{n} tokens exceed the cache's {hi}-token "
+                         f"capacity")
     b = lo
     while b < n:
         b *= 2
+    if hi is not None and b > hi:
+        b = hi
     return b
 
 
@@ -46,10 +65,8 @@ def sample_batched(logits: jax.Array, key: jax.Array, temps: jax.Array,
     safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
     scaled = logits / safe_t
     if top_k > 0:
-        from butterfly_tpu.engine.sampling import _apply_top_k
         scaled = _apply_top_k(scaled, top_k)
     if top_p < 1.0:
-        from butterfly_tpu.engine.sampling import _apply_top_p
         scaled = _apply_top_p(scaled, top_p)
     drawn = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temps > 0, drawn, greedy)
@@ -128,6 +145,13 @@ class ServingEngine:
         self._decode = jax.jit(
             partial(_decode_all, self.cfg, fwd, use_kernel=use_kernels),
             static_argnums=(5, 6), donate_argnums=(2,))
+        # Fused decode blocks: one jitted program per block width k
+        # (_decode_scan — k is a static scan length). Built lazily; a
+        # deployment runs ONE decode_steps_per_tick, so this compiles
+        # once in practice.
+        self._fwd = fwd
+        self._use_kernels = use_kernels
+        self._decode_blocks: Dict[int, object] = {}
         # batched multi-token greedy verify (scheduler speculative mode)
         self._verify = jax.jit(
             partial(_verify_all, self.cfg, fwd), donate_argnums=(2,))
@@ -184,7 +208,7 @@ class ServingEngine:
         start..start+len-1) against the slot's pages; returns the chunk's
         last-token logits [V]. start==0 is a fresh prefill (flash-kernel
         eligible); start>0 continues a warm cache through the dense path."""
-        T = bucket_len(len(tokens))
+        T = bucket_len(len(tokens), hi=self.cache.max_seq)
         buf = np.zeros((1, T), np.int32)
         buf[0, :len(tokens)] = tokens
         prog = self._prefill if start == 0 else self._prefill_warm
@@ -238,6 +262,46 @@ class ServingEngine:
                 self.runtime_top_k, self.runtime_top_p, key)
         self.cache = cache
         return nxt, logits
+
+    def _decode_block_prog(self, k: int):
+        prog = self._decode_blocks.get(k)
+        if prog is None:
+            prog = jax.jit(
+                partial(_decode_scan, self.cfg, self._fwd, k,
+                        use_kernel=self._use_kernels),
+                static_argnums=(7, 8), donate_argnums=(2,))
+            self._decode_blocks[k] = prog
+        return prog
+
+    def decode_block_async(self, tokens, active: np.ndarray,
+                           temps: np.ndarray, stops: np.ndarray,
+                           budgets: np.ndarray, key: jax.Array,
+                           k: int) -> Tuple[jax.Array, jax.Array]:
+        """Dispatch ONE fused k-step decode block, no host sync.
+
+        k chained decode iterations run inside a single jitted lax.scan
+        (_decode_scan): one dispatch, per-step keys derived on device,
+        donated KV pools riding the carry. `stops` [S] holds each
+        slot's EOS id (-1 = none) and `budgets` [S] its remaining-token
+        allowance; a slot that emits its stop token or spends its
+        budget mid-block goes dead ON DEVICE — lengths stop advancing,
+        writes land on the null page — instead of generating garbage
+        the host must discard. Returns (block [k, S], final [S]), both
+        device-resident: the stacked per-step tokens for the
+        scheduler's stacked drain, and the final token vector for
+        chaining the next dispatch (the same contract
+        decode_active_async's return value carries).
+        """
+        self._sync_table()
+        with self._mesh_ctx():
+            block, final, cache = self._decode_block_prog(k)(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(active, bool), jnp.asarray(temps),
+                jnp.asarray(stops, jnp.int32),
+                jnp.asarray(budgets, jnp.int32),
+                self.runtime_top_k, self.runtime_top_p, key)
+        self.cache = cache
+        return block, final
 
     def verify_active(self, tokens: np.ndarray,
                       active: np.ndarray) -> np.ndarray:
@@ -310,6 +374,50 @@ def _decode_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,
     last = logits[:, -1, :]
     nxt = sample_batched(last, key, temps, top_k, top_p)
     return nxt, last, cache
+
+
+def _decode_scan(cfg: ModelConfig, fwd, k: int, params, tokens,
+                 cache: PagedKVCache, active, temps, stops, budgets,
+                 top_k: int, top_p: float, key, use_kernel: bool = False):
+    """k chained decode iterations in ONE lax.scan; [S] slots each step.
+
+    Carry: (cur tokens [S], cache, live [S] bool, remaining budgets
+    [S]). Step i consumes cur — writing its K/V where live, advancing
+    live lengths — and samples the next token with the device-derived
+    key fold_in(key, i), so the host pays one dispatch, one operand
+    conversion, and one RNG split per BLOCK instead of per token.
+
+    Liveness is the device twin of the host's stop/max_new truncation:
+    a slot starts dead if it is inactive, its budget is already spent,
+    or its incoming chain token is its stop id (an undrained
+    admission-time first token can be EOS); it goes dead the moment a
+    sampled token hits the stop id or spends the budget. Dead steps
+    freeze the slot's token (the drain discards them anyway), write to
+    the null page, and leave lengths at the written-token count — so a
+    mid-block finish can never grow pages or attend past the EOS.
+
+    Returns (block [k, S] stacked step tokens, final [S] chain vector,
+    cache).
+    """
+    has_stop = stops >= 0
+    live = active & (budgets > 0) \
+        & jnp.where(has_stop, tokens != stops, True)
+
+    def body(carry, i):
+        cur, cache, live, rem = carry
+        logits, cache = fwd(params, cfg, cur[:, None], cache,
+                            active=live, use_kernel=use_kernel)
+        nxt = sample_batched(logits[:, -1, :], jax.random.fold_in(key, i),
+                             temps, top_k, top_p)
+        nxt = jnp.where(live, nxt, cur)
+        rem = jnp.where(live, rem - 1, rem)
+        live = live & (rem > 0) & jnp.where(has_stop, nxt != stops, True)
+        return (nxt, cache, live, rem), nxt
+
+    (final, cache, _, _), block = lax.scan(
+        body, (tokens, cache, live, budgets),
+        jnp.arange(k, dtype=jnp.int32))
+    return block, final, cache
 
 
 def _verify_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,

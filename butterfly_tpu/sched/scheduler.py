@@ -9,7 +9,8 @@ Host-side policy over the static-shape device programs in
 engine/serving.py:
 
 * tick() = [≤ prefill_chunk tokens of (chunked) prefill work] then
-  [decode_steps_per_tick batched decode steps for all active slots].
+  [ONE fused decode block of decode_steps_per_tick iterations for all
+  active slots — a single jitted scan, engine._decode_scan].
   Long prompts are split into prefill_chunk-sized pieces that continue
   the warm cache across ticks, so a max-length admission can never
   head-of-line-block decoding requests for more than one chunk.
@@ -120,16 +121,17 @@ class Scheduler:
         self._ids = itertools.count()
         self._key = jax.random.PRNGKey(seed)
         self._next_tokens = np.zeros((engine.num_slots,), np.int32)
-        # In-flight decode steps: [(device token vector, slot->request
-        # snapshot), ...] in dispatch order. Each step is dispatched
-        # chained on the previous step's DEVICE tokens, so a whole
-        # tick's decode_steps_per_tick steps run back-to-back on the
-        # device with no host round trip; the host drains them all in
-        # ONE stacked fetch at the next tick's start. One fetch per
-        # tick instead of one per token is what makes the decode loop
-        # survive high host<->device latency (the dev tunnel here has
-        # ~100 ms dispatch+fetch RTT; real hosts still save the
-        # per-step sync).
+        # In-flight fused decode blocks: [(final device token vector
+        # [S], stacked block [k, S], k, slot->request snapshot,
+        # dispatch timestamp), ...] in dispatch order. Each tick
+        # dispatches ONE jitted k-step scan (engine.decode_block_async)
+        # chained on the previous block's device-resident final tokens,
+        # and the host drains everything in ONE stacked fetch at the
+        # next tick's start. One dispatch + one fetch per tick instead
+        # of k is what closes the serving loop toward the isolated-
+        # decode ceiling (BENCH_r05: 4,156 vs 6,988 tok/s/chip) and
+        # what makes it survive high host<->device latency (the dev
+        # tunnel here has ~100 ms dispatch+fetch RTT).
         self._inflight: List[tuple] = []
         # First tokens sampled on-device at admission, not yet fetched:
         # [(req, generation=req.preemptions, slot, device scalar)].
@@ -182,6 +184,12 @@ class Scheduler:
             "prefill_tokens",
             "Prompt tokens prefilled per admission (prefix-cache hits "
             "excluded)", TOKEN_BUCKETS)
+        self._h_decode_block = reg.histogram(
+            "decode_block_seconds",
+            "Fused decode block wall latency: dispatch to stacked "
+            "drain (covers decode_steps_per_tick device steps plus "
+            "any host work interleaved before the next tick's drain)",
+            LATENCY_BUCKETS)
         # latency reservoirs: both bounded to the same recent window so
         # the two adjacent metrics share time-horizon semantics (and a
         # long-lived server doesn't leak one float per request forever)
@@ -289,40 +297,46 @@ class Scheduler:
         raise RuntimeError("scheduler did not drain")
 
     def tick(self) -> int:
-        """One scheduling round: bounded prefill work, then decode step(s).
+        """One scheduling round: bounded prefill work, then a decode block.
 
         Continuous mode interleaves at most `prefill_chunk` prompt tokens
-        of (possibly partial) prefill with `decode_steps_per_tick` decode
-        steps, bounding every decoding request's inter-token gap under
-        admission pressure. Returns the number of tokens generated this
-        round (throughput accounting for the serve loop)."""
+        of (possibly partial) prefill with ONE fused decode block of
+        `decode_steps_per_tick` iterations (a single jitted scan —
+        _decode_block), bounding every decoding request's inter-token
+        gap under admission pressure. Returns the number of tokens
+        generated this round (throughput accounting for the serve
+        loop)."""
         before = self._c_tokens.value
-        # consume any step still in flight BEFORE admission: admission
+        # consume any block still in flight BEFORE admission: admission
         # must see finished slots, and a prefill dispatched over a stale
-        # in-flight step would race the table sync
+        # in-flight block would race the table sync
         self._drain_inflight()
         self._admit()
         spec = self.engine.runtime.speculative_gamma > 0
         k = max(1, self.engine.runtime.decode_steps_per_tick)
         if self.running:
             self._h_batch.observe(len(self.running))
-        if not spec:
-            # Preallocate the whole tick's pages up front: the per-step
-            # growth checks below then find capacity already there, so
-            # the block table dirties (and syncs to the device) at most
-            # once per TICK instead of once per chained dispatch —
-            # measured as a large share of the full-batch serving gap
-            # (docs/decode_profile_r5.md capacity section).
-            # k+1 = the worst per-step need below (depth k-1, +2) — any
-            # more would add spurious page pressure in a tight pool
+        if spec:
+            # speculative rounds stay synchronous single dispatches
+            # (each round's drafts need the previous round's tokens on
+            # the host), so the fused block doesn't apply
+            for _ in range(k):
+                if self.running:
+                    self._spec_step()
+        else:
+            # Preallocate the whole block's pages up front: the fused
+            # scan's k steps then find capacity already there, so the
+            # block table dirties (and syncs to the device) at most
+            # once per TICK — measured as a large share of the
+            # full-batch serving gap (docs/decode_profile_r5.md
+            # capacity section). k+1 = chain token + k new samples —
+            # any more would add spurious page pressure in a tight pool
             for req in list(self.running):
                 if req in self.running:
                     need = min(len(req.all_tokens) + k + 1,
                                len(req.prompt) + req.max_new_tokens)
                     self._ensure_or_preempt(req, need)
-        for _ in range(k):
-            if self.running:
-                self._spec_step() if spec else self._decode_step()
+            self._decode_block(k)
         made = int(self._c_tokens.value - before)
         if self.trace is not None:
             # one global event per tick: the decode batch this round —
@@ -330,7 +344,8 @@ class Scheduler:
             self.trace.event(None, "decode_tick",
                              batch=len(self.running),
                              waiting=len(self.waiting),
-                             steps=k, generated=made)
+                             steps=k, block_steps=0 if spec else k,
+                             generated=made)
         return made
 
     def metrics(self) -> Dict[str, float]:
@@ -465,33 +480,51 @@ class Scheduler:
             self._pending_first.append(
                 (req, req.preemptions, req.slot, first))
 
-    def _decode_step(self) -> None:
-        # Page growth happened at tick start (tick()'s preallocation
-        # covers every chained dispatch of the tick: its len+k+1 bound
-        # dominates any step's len+depth+2 with depth <= k-1, and the
-        # running set can only shrink between dispatches), so this
-        # dispatch only assembles operands and chains the step.
+    def _decode_block(self, k: int) -> None:
+        """Dispatch ONE fused k-step decode block for the running set
+        (engine.decode_block_async). Host work — operand assembly, the
+        jnp.asarray conversions, the RNG split, the dispatch itself —
+        is paid once per BLOCK instead of once per token; page growth
+        happened at tick start (the len+k+1 preallocation covers every
+        step of the scan).
+
+        Per-slot stop ids and remaining-token budgets ride into the
+        scan so a slot that finishes mid-block is masked ON DEVICE
+        (lengths freeze, writes land on the null page) rather than
+        generating garbage the drain discards.
+        """
         if not self.running:
             return
-
-        active = np.zeros((self.engine.num_slots,), bool)
-        temps = np.zeros((self.engine.num_slots,), np.float32)
+        S = self.engine.num_slots
+        active = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        stops = np.full((S,), -1, np.int32)
+        budgets = np.zeros((S,), np.int32)
         for req in self.running:
             active[req.slot] = True
             temps[req.slot] = req.temperature
+            stops[req.slot] = req.stop_token
+            # tokens the request may still emit: max_new minus what the
+            # host has drained, minus an undrained admission-time first
+            # token (queued this tick in _pending_first)
+            pending = any(f[0] is req and f[1] == req.preemptions
+                          for f in self._pending_first)
+            budgets[req.slot] = (req.max_new_tokens - len(req.output)
+                                 - int(pending))
+        if not (active & (budgets > 0)).any():
+            return  # every runner is out of budget: nothing to decode
         self._key, sub = jax.random.split(self._key)
-        # chain on the newest in-flight step's device tokens (no host
-        # sync); otherwise the device token vector admissions write
-        # into; the host vector only on the cold first dispatch
-        if self._inflight:
-            cur = self._inflight[-1][0]
-        elif self._next_dev is not None:
-            cur = self._next_dev
-        else:
-            cur = self._next_tokens
-        nxt = self.engine.decode_active_async(cur, active, temps, sub)[0]
-        self._next_dev = nxt
-        self._inflight.append((nxt, {req.slot: req for req in self.running}))
+        # chain on the device token vector admissions write into (which
+        # the previous block's final vector seeded); the host vector
+        # only on the cold first dispatch
+        cur = self._next_dev if self._next_dev is not None \
+            else self._next_tokens
+        block, final = self.engine.decode_block_async(
+            cur, active, temps, stops, budgets, sub, k)
+        self._next_dev = final
+        self._inflight.append(
+            (final, block, k, {req.slot: req for req in self.running},
+             time.monotonic()))
 
     def _spec_step(self) -> None:
         """One speculative round: per-slot prompt-lookup drafts, ONE
@@ -551,25 +584,28 @@ class Scheduler:
         self.engine.fix_lengths(mask, vals)
 
     def _drain_inflight(self) -> None:
-        """Read every pending first token and in-flight step (ONE
-        stacked device fetch) and do their host bookkeeping in
+        """Read every pending first token and in-flight decode block
+        (ONE stacked device fetch) and do their host bookkeeping in
         chronological order: firsts were queued at admission, before
-        any of the currently in-flight steps were dispatched.
+        any of the currently in-flight blocks were dispatched; each
+        block's [k, S] rows are emitted in step order, truncated per
+        request at its stop token / max_new by _emit.
 
         Requests that finished or were preempted between dispatch and
-        drain have their tokens discarded (the dispatched steps computed
-        them anyway — their cache writes are overwritten before any
-        later attend can see them, the overwrite-before-attend
-        invariant).
+        drain have their tokens discarded; slots that went dead
+        mid-block carry frozen repeats of their last token, which the
+        done-check below skips (the device stopped their writes and
+        length growth inside the scan).
         """
         if not self._inflight and not self._pending_first:
             return
         pending, self._inflight = self._inflight, []
         firsts, self._pending_first = self._pending_first, []
         parts = [f[3].reshape(1) for f in firsts] + \
-            [nxt.reshape(-1) for nxt, _ in pending]
+            [block.reshape(-1) for _, block, _, _, _ in pending]
         vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
             else np.asarray(parts[0])
+        now = time.monotonic()
         nf = len(firsts)
         S = self.engine.num_slots
         for (req, gen, slot, _), tok in zip(firsts, vals[:nf]):
@@ -579,13 +615,17 @@ class Scheduler:
                 continue
             self._next_tokens[slot] = int(tok)
             self._emit(req, int(tok))
-        rows = vals[nf:].reshape(len(pending), S) if pending else ()
-        for row, (_, snapshot) in zip(rows, pending):
-            for slot, req in snapshot.items():
-                if req.done or req.slot != slot:
-                    continue
-                self._next_tokens[slot] = int(row[slot])
-                self._emit(req, int(row[slot]))
+        off = nf
+        for _, block, k, snapshot, t_dispatch in pending:
+            self._h_decode_block.observe(now - t_dispatch)
+            rows = vals[off:off + k * S].reshape(k, S)
+            off += k * S
+            for row in rows:
+                for slot, req in snapshot.items():
+                    if req.done or req.slot != slot:
+                        continue
+                    self._next_tokens[slot] = int(row[slot])
+                    self._emit(req, int(row[slot]))
 
     def _emit(self, req: Request, token: int) -> None:
         """Record one generated token; finish/stop bookkeeping."""

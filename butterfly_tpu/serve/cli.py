@@ -110,13 +110,15 @@ def build_parser() -> argparse.ArgumentParser:
         return n
 
     s.add_argument("--decode-steps-per-tick", type=positive_int, default=1,
-                   help="decode steps chained device-side per scheduler "
-                        "tick; the host drains their tokens in ONE "
-                        "stacked fetch. Raise on high host<->device "
-                        "latency setups (tokens then surface in bursts "
-                        "of this size). NB: with --speculate the verify "
-                        "rounds are host-synchronous, so the chaining "
-                        "benefit applies to plain decoding only")
+                   help="fused decode-block width: this many decode "
+                        "iterations run per scheduler tick inside ONE "
+                        "jitted scan (on-device sampling, RNG, and EOS "
+                        "masking), drained in ONE stacked fetch. Raise "
+                        "to amortize per-token host overhead (tokens "
+                        "then surface in bursts of this size). NB: with "
+                        "--speculate the verify rounds are "
+                        "host-synchronous, so the block applies to "
+                        "plain decoding only")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)

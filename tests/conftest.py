@@ -88,6 +88,11 @@ SLOW_TESTS = {
     "test_request_sized_to_page_cap_completes",
     "test_speculative_scheduler_accepts_drafts",
     "test_speculative_scheduler_stop_token",
+    # fused-block scenarios that compile a second scheduler / a wide
+    # scan (the fast tier still covers the fused path: every core
+    # parity test decodes through it, incl. test_decode_steps_per_tick)
+    "test_fused_block_greedy_parity",
+    "test_fused_block_seeded_sampling_reproducible",
 }
 
 

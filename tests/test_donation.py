@@ -72,6 +72,13 @@ def test_serving_paths_alias(tiny_model):
         for i in range(3):
             toks, _ = eng.decode_active(toks, active, temps,
                                         jax.random.PRNGKey(i))
+        # fused decode block: the scan-carried pools must alias too
+        # (a non-aliasing carry would keep a second pool live for the
+        # whole block — the exact cost the fusion exists to avoid)
+        stops = np.full(4, -1, np.int32)
+        budgets = np.full(4, 4, np.int32)
+        eng.decode_block_async(toks, active, temps, stops, budgets,
+                               jax.random.PRNGKey(9), 4)
 
 
 def test_pipeline_generate_aliases(tiny_model):

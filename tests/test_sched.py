@@ -323,6 +323,68 @@ def test_speculative_scheduler_stop_token():
     assert got.output == want.output
 
 
+# -- fused decode block (engine._decode_scan, ISSUE 3) ----------------------
+
+
+def test_fused_block_greedy_parity():
+    """Tentpole contract: decode_steps_per_tick=8 — one jitted scan per
+    tick with on-device RNG/EOS/budget masking — is token-for-token
+    identical to single-step decode at temperature 0, across slots with
+    different prompts and lengths (the sched/serving_mesh parity
+    contract extended to the fused block)."""
+    ref, params = make_sched(max_batch=4, max_seq=64)
+    fused, _ = make_sched(max_batch=4, max_seq=64, decode_steps_per_tick=8)
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
+    want = [ref.submit(p, max_new_tokens=12) for p in prompts]
+    ref.run_until_done()
+    got = [fused.submit(p, max_new_tokens=12) for p in prompts]
+    fused.run_until_done()
+    assert [r.output for r in got] == [r.output for r in want]
+    # and the single-step path itself still matches the offline engine
+    assert want[0].output == ref_tokens(params, prompts[0], 12)
+
+
+def test_fused_block_seeded_sampling_reproducible():
+    """temperature>0 through the fused block: per-step keys are derived
+    on device (fold_in of one per-block key), so the same seed and
+    config must reproduce the same tokens run-to-run."""
+    outs = []
+    for _ in range(2):
+        sched, _ = make_sched(max_batch=2, max_seq=64, seed=7,
+                              decode_steps_per_tick=4)
+        r1 = sched.submit([5, 7, 11], max_new_tokens=10, temperature=0.8)
+        r2 = sched.submit([3, 1], max_new_tokens=8, temperature=1.3)
+        sched.run_until_done()
+        outs.append((list(r1.output), list(r2.output)))
+    assert outs[0] == outs[1]
+    assert len(outs[0][0]) == 10 and len(outs[0][1]) == 8
+
+
+def test_fused_block_eos_mid_block():
+    """A stop token sampled mid-block kills the slot ON DEVICE: the host
+    sees no post-EOS tokens, and the slot's device length froze at the
+    written-token count (no post-EOS page growth) instead of advancing
+    through the remaining scan steps."""
+    ref, _ = make_sched(max_batch=2, max_seq=64)
+    base = ref.submit([5, 7, 11], max_new_tokens=12)
+    ref.run_until_done()
+    stop = base.output[2]  # EOS lands at the 3rd generated token
+
+    sched, _ = make_sched(max_batch=2, max_seq=64, decode_steps_per_tick=8)
+    req = sched.submit([5, 7, 11], max_new_tokens=12, stop_token=stop)
+    sched.tick()  # admit + prefill + first sample + one 8-step block
+    slot = req.slot
+    # The block has run past the EOS position on device. Written K/V:
+    # 3 prompt tokens + generated tokens 1 and 2; the EOS (3rd) is
+    # sampled but never consumed, and every later step was masked dead
+    # — lengths froze, writes landed on the null page.
+    assert int(np.asarray(sched.engine.cache.lengths)[slot]) == 3 + 2
+    sched.run_until_done()
+    assert req.output == base.output[:3]
+    assert req.state == "finished"
+    assert sched.alloc.free_pages == sched.alloc.num_pages
+
+
 # -- tracing + instrument wiring (obs/trace.py, obs/registry.py) ------------
 
 def test_scheduler_trace_timeline():
