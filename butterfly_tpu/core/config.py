@@ -182,6 +182,14 @@ class RuntimeConfig:
                                       # NB: chunks pad to the engine's
                                       # 16-token bucket floor — values < 16
                                       # add compute without cutting latency
+    prefill_max_batch: int = 8        # max waiting requests gang-admitted
+                                      # into ONE batched [B, Tbucket]
+                                      # prefill dispatch per scheduler
+                                      # tick (sched/scheduler.py group
+                                      # admission). B is bucketed to the
+                                      # next power of two (clamped here)
+                                      # so at most log2(this)+1 batch
+                                      # shapes ever compile per T bucket
     page_size: int = 16               # paged-KV tokens per block
     num_pages: int = 0                # 0 => derive from max_batch/max_seq
     scheduler: str = "continuous"     # "continuous" (chunked-prefill/decode

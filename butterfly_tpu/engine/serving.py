@@ -4,10 +4,15 @@ The continuous-batching scheduler (sched/scheduler.py) drives two jitted
 device programs, both static-shape so batch composition changes never
 recompile (SURVEY.md §7 "hard parts"):
 
-* `prefill_slot`: one request's padded prompt [1, Tbucket] against the
-  shared page pool, targeting only that request's block-table row. Prompt
-  lengths are bucketed (next power of two) so at most log2(max_seq)
-  prefill programs ever compile.
+* `prefill_batch`: B requests' padded prompt chunks [B, Tbucket] against
+  the shared page pool as ONE dispatch, each row targeting only that
+  request's block-table row (per-row start/length masking — the same
+  write/mask machinery paged_forward uses for a single slot). Chunk
+  lengths bucket to the next power of two and B buckets to the next
+  power of two clamped at runtime.prefill_max_batch, so at most
+  (#B-buckets x #T-buckets) prefill programs ever compile per
+  fresh/warm flavor; the single-request path is simply B=1 (same jit
+  cache, same [1, Tbucket] programs as before).
 * `decode_active`: one token for ALL slots [S,1]; inactive slots are
   masked via `active` (their lengths don't advance, their writes land on
   the null page). Sampling is vectorized with per-slot temperature so
@@ -56,6 +61,20 @@ def bucket_len(n: int, lo: int = 16, hi: Optional[int] = None) -> int:
     if hi is not None and b > hi:
         b = hi
     return b
+
+
+def bucket_batch(n: int, hi: int) -> int:
+    """Next power-of-two batch bucket >= n, clamped to hi.
+
+    n > hi returns n exactly (still a static shape — the caller asked
+    for a wider gang than the configured cap, so pay one extra program
+    rather than refuse)."""
+    if n >= hi:
+        return n
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, hi)
 
 
 def sample_batched(logits: jax.Array, key: jax.Array, temps: jax.Array,
@@ -204,35 +223,73 @@ class ServingEngine:
 
     def prefill_chunk(self, slot: int, tokens: list[int],
                       start: int) -> jax.Array:
-        """Run one chunk of a request's prompt (absolute positions
-        start..start+len-1) against the slot's pages; returns the chunk's
-        last-token logits [V]. start==0 is a fresh prefill (flash-kernel
-        eligible); start>0 continues a warm cache through the dense path."""
-        T = bucket_len(len(tokens), hi=self.cache.max_seq)
-        buf = np.zeros((1, T), np.int32)
-        buf[0, :len(tokens)] = tokens
-        prog = self._prefill if start == 0 else self._prefill_warm
+        """Run one chunk of one request's prompt; returns the chunk's
+        last-token logits [V]. The B=1 case of prefill_batch — same jit
+        cache, same [1, Tbucket] programs."""
+        return self.prefill_batch([slot], [tokens], [start])[0]
+
+    def prefill_batch(self, slots: list[int], chunks: list[list[int]],
+                      starts: list[int]) -> jax.Array:
+        """Run one prompt chunk for EACH of B requests as ONE jitted
+        [B, Tbucket] dispatch; returns last-position logits [B, V]
+        (device-resident — row i is member i's next-token distribution,
+        so every gang member's first token can sample from the same
+        dispatch).
+
+        Member i's chunk occupies absolute positions
+        starts[i]..starts[i]+len(chunks[i])-1 of its slot's pages; rows
+        are individually length-masked (paged_forward's per-slot
+        start/length machinery), so members with different chunk lengths
+        share a dispatch. B pads to the next power-of-two bucket
+        (clamped at runtime.prefill_max_batch); padding rows carry a
+        null-page table row, so their writes land on the null page and
+        their logits are discarded. The whole gang must agree on
+        freshness: all starts==0 dispatches the fresh program
+        (flash-kernel eligible), any warm member routes the gang through
+        the dense warm program — the scheduler groups members so this
+        never mixes.
+        """
+        B = len(slots)
+        T = bucket_len(max(len(c) for c in chunks), hi=self.cache.max_seq)
+        Bb = bucket_batch(B, max(1, min(self.runtime.prefill_max_batch,
+                                        self.num_slots)))
+        buf = np.zeros((Bb, T), np.int32)
+        # padding rows: 1 token (a real last_index), null table row
+        lens = np.ones((Bb,), np.int32)
+        sts = np.zeros((Bb,), np.int32)
+        rows = np.full((Bb, self.cache.page_table.shape[1]),
+                       self.cache.null_page, np.int32)
+        for i, (slot, toks, start) in enumerate(zip(slots, chunks, starts)):
+            buf[i, :len(toks)] = toks
+            lens[i] = len(toks)
+            sts[i] = start
+            # host mirror is authoritative (host is the only writer):
+            # no device gather of the slot's table row needed
+            rows[i] = self._host_table[slot]
+        fresh = all(s == 0 for s in starts)
+        prog = self._prefill if fresh else self._prefill_warm
         if self.tracer is not None:
-            self.tracer.event(None, "engine.prefill_dispatch", slot=slot,
-                              tokens=len(tokens), bucket=T, start=start,
-                              fresh=start == 0)
+            self.tracer.event(None, "engine.prefill_dispatch",
+                              slots=list(slots), batch=B, batch_bucket=Bb,
+                              tokens=int(sum(len(c) for c in chunks)),
+                              bucket=T, fresh=fresh)
         self._sync_table()
         with self._mesh_ctx():
-            # pools are donated (scatters land in place); the slot's table
-            # row rides separately so the donation set has no unaliasable
-            # leaves (the row has no matching output)
+            # pools are donated (scatters land in place); the table rows
+            # ride separately so the donation set has no unaliasable
+            # leaves (the rows have no matching output)
             pools = (self.cache.k_pages, self.cache.v_pages,
                      self.cache.k_scale_pages, self.cache.v_scale_pages)
             logits, pools = prog(
-                self.params, jnp.asarray(buf), pools,
-                self.cache.page_table[slot][None],
-                jnp.asarray([len(tokens)], jnp.int32),
-                jnp.asarray([start], jnp.int32))
+                self.params, jnp.asarray(buf), pools, jnp.asarray(rows),
+                jnp.asarray(lens), jnp.asarray(sts))
+            new_lens = jnp.asarray(sts[:B] + lens[:B])
             self.cache = self.cache._replace(
                 k_pages=pools[0], v_pages=pools[1],
                 k_scale_pages=pools[2], v_scale_pages=pools[3],
-                lengths=self.cache.lengths.at[slot].set(start + len(tokens)))
-        return logits[0]
+                lengths=self.cache.lengths.at[
+                    np.asarray(slots, np.int32)].set(new_lens))
+        return logits[:B]
 
     def decode_active(self, tokens: np.ndarray, active: np.ndarray,
                       temps: np.ndarray, key: jax.Array
@@ -341,17 +398,21 @@ class ServingEngine:
 
 
 def _prefill_slot(cfg: ModelConfig, fresh: bool, fwd, params, tokens,
-                  pools, table_row, true_len, start):
-    """[1,T] prompt chunk against the slot's table row; pool-wide scatter.
+                  pools, table_rows, true_len, start):
+    """[B,T] prompt chunks against B slots' table rows; pool-wide scatter.
 
     `pools` is the (k, v[, k_scale, v_scale]) pool tuple (donated —
-    scatters land in place), paired with ONE slot's table row; `start`
-    [1] is the chunk's first absolute position; `fresh` (static) means
-    start==0 and the slot's pages are empty (flash-path eligible). `fwd`
-    is paged_forward or its stage-pipelined twin.
+    scatters land in place), paired with the B member slots' table rows
+    [B, max_pages]; `start` [B] is each chunk's first absolute position;
+    `fresh` (static) means every start==0 and the members' pages are
+    empty (flash-path eligible). `fwd` is paged_forward or its
+    stage-pipelined twin. B=1 is the classic single-slot prefill; the
+    batched gang prefill (ServingEngine.prefill_batch) is the same
+    program at B>1.
     """
-    cache1 = PagedKVCache(pools[0], pools[1], table_row,
-                          jnp.zeros((1,), jnp.int32), pools[2], pools[3])
+    cache1 = PagedKVCache(pools[0], pools[1], table_rows,
+                          jnp.zeros((tokens.shape[0],), jnp.int32),
+                          pools[2], pools[3])
     B, T = tokens.shape
     positions = start[:, None] + jnp.broadcast_to(jnp.arange(T)[None, :],
                                                   (B, T))

@@ -120,6 +120,8 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                           max_batch: int = 32, utilization: float = 0.75,
                           kv_quant: str = "int8",
                           decode_steps_per_tick: int = 1,
+                          prefill_max_batch: Optional[int] = None,
+                          isolated_decode_tok_s_chip: Optional[float] = None,
                           seed: int = 0) -> Dict:
     """Benchmark the PRODUCT serving path: Scheduler + ServingEngine with
     the paged pool (int8 codes by default) and the Pallas paged-attention
@@ -130,7 +132,10 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     `utilization` x that measured capacity give TTFT/ITL percentiles
     under a stable queue (not an arbitrary queue blow-up).
     Returns both (the BASELINE.md metrics of record: tokens/sec/chip
-    and p50 TTFT).
+    and p50 TTFT). When the caller supplies the isolated-decode number
+    (bench.py does), `serving_gap` = serving / isolated tok/s/chip rides
+    the JSON so the bench trajectory tracks the serving-vs-isolated gap
+    directly.
     """
     import jax
     from butterfly_tpu.core.config import RuntimeConfig
@@ -141,6 +146,8 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                        max_seq_len=prompt_len + max_new + 16,
                        kv_quant=kv_quant,
                        decode_steps_per_tick=decode_steps_per_tick)
+    if prefill_max_batch is not None:
+        rt = rt.replace(prefill_max_batch=prefill_max_batch)
     engine = ServingEngine(model, params, rt)
     rng = np.random.RandomState(seed)
     V = model.cfg.vocab_size
@@ -148,12 +155,23 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     def prompt():
         return rng.randint(1, V, (prompt_len,)).tolist()
 
-    # warmup: compiles the prefill bucket + decode program off the clock,
-    # then times steady full-pipeline decode ticks for rate calibration
+    # warmup: compiles the prefill + decode programs off the clock. One
+    # burst per power-of-two gang width up to prefill_max_batch — each
+    # burst forms groups under the same budget/bucketing rules as
+    # production traffic, so every [B-bucket, T-bucket] batched-prefill
+    # program the measured phases can hit compiles here, not inside a
+    # phase-2 TTFT sample (a mid-run XLA compile would dominate p95)
     warm = Scheduler(engine)
-    for _ in range(2):
-        warm.submit(prompt(), max_new_tokens=4)
-    warm.run_until_done()
+    cap = max(1, min(rt.prefill_max_batch, max_batch))
+    widths, w = [], 1
+    while w < cap:
+        widths.append(w)
+        w *= 2
+    widths.append(cap)
+    for w in widths:
+        for _ in range(w):
+            warm.submit(prompt(), max_new_tokens=4)
+        warm.run_until_done()
     # Phase 1 — MEASURED saturated capacity: submit a standing backlog
     # all at once and time the drain. Every earlier attempt to MODEL
     # sustained capacity from probe tick times (decode-only, then
@@ -216,10 +234,22 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
         "serving_prompt_len": prompt_len,
         "serving_max_new": max_new,
         "serving_max_batch": max_batch,
+        "serving_prefill_max_batch": rt.prefill_max_batch,
         "serving_offered_utilization": utilization,
         "serving_kv_quant": kv_quant,
         "serving_preemptions": m["preemptions_total"],
     }
+    # prompt-token throughput of the admission path (phase-2 wall): the
+    # quantity batched group prefill exists to raise — prefix-cache hits
+    # excluded, the histogram only sees tokens actually run
+    h_prefill = sched.registry.get("prefill_tokens")
+    if h_prefill is not None:
+        out["prefill_tokens_per_sec"] = h_prefill.sum / wall
+    if isolated_decode_tok_s_chip:
+        # serving / isolated-decode tok/s/chip: 1.0 = the serving stack
+        # adds zero overhead over a bare fused decode loop
+        out["serving_gap"] = (out["serving_tokens_per_sec_per_chip"]
+                              / isolated_decode_tok_s_chip)
     # itl_req_mean_* are the PRIMARY ITL keys: per-finished-request mean
     # gap, the streaming rate a client experiences. The raw-gap
     # percentiles bimodalize under per-tick stacked-drain bursts (r05

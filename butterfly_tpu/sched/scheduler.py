@@ -8,12 +8,20 @@ BASELINE.json configs[4] serving shape.
 Host-side policy over the static-shape device programs in
 engine/serving.py:
 
-* tick() = [≤ prefill_chunk tokens of (chunked) prefill work] then
-  [ONE fused decode block of decode_steps_per_tick iterations for all
-  active slots — a single jitted scan, engine._decode_scan].
+* tick() = [≤ prefill_chunk tokens of GROUP prefill work — waiting
+  requests are gang-admitted, up to prefill_max_batch of them, and
+  their next chunks run as batched [B, Tbucket] dispatches
+  (engine.prefill_batch), bucketed by chunk length] then [ONE fused
+  decode block of decode_steps_per_tick iterations for all active
+  slots — a single jitted scan, engine._decode_scan]. Nothing in
+  between forces a host sync: prefill logits stay device-resident,
+  first tokens sample on device, and the decode block chains on the
+  device token vector — prefill and decode pipeline within the tick.
   Long prompts are split into prefill_chunk-sized pieces that continue
-  the warm cache across ticks, so a max-length admission can never
-  head-of-line-block decoding requests for more than one chunk.
+  the warm cache across ticks (partially-prefilled gang members carry
+  over), so a max-length admission can never head-of-line-block
+  decoding requests for more than one chunk, and a burst of arrivals
+  prefills as a group instead of one prompt per tick.
 * scheduler="static" disables interleaving: a whole batch is admitted
   (full prompts at once) only when the previous batch has fully drained —
   the classic throughput-oriented static-batching mode.
@@ -39,7 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from butterfly_tpu.cache.allocator import make_page_allocator
-from butterfly_tpu.engine.serving import ServingEngine, sample_batched
+from butterfly_tpu.engine.serving import (
+    ServingEngine, bucket_len, sample_batched)
 from butterfly_tpu.obs.registry import (
     BATCH_BUCKETS, LATENCY_BUCKETS, TOKEN_BUCKETS, MetricsRegistry)
 
@@ -116,7 +125,15 @@ class Scheduler:
                                              num_slots=engine.num_slots)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
-        self._prefilling: Optional[Request] = None  # mid-chunked-prefill
+        # The prefill GROUP: requests admitted to slots whose prompts are
+        # not yet fully in the KV cache. Each tick their next chunks are
+        # packed under the prefill_chunk token budget and dispatched as
+        # batched [B, Tbucket] prefills (engine.prefill_batch);
+        # partially-prefilled members carry over to the next tick. This
+        # replaces the old single `_prefilling` request — a burst of
+        # arrivals no longer serializes one [1, Tbucket] dispatch per
+        # prompt while decode slots sit idle.
+        self._prefill_group: List[Request] = []
         self.slots: List[Optional[Request]] = [None] * engine.num_slots
         self._ids = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -138,6 +155,12 @@ class Scheduler:
         # Fetched with the same stacked drain (a per-admission host
         # fetch would pay the full dispatch+fetch RTT per request).
         self._pending_first: List[tuple] = []
+        # Membership index over _pending_first, keyed (request id,
+        # preemptions) and refreshed at drain time: _decode_block's
+        # budget computation and _written ask "does req have an
+        # undrained first token?" per runner — a set lookup instead of
+        # the old O(running x pending) linear scan.
+        self._pending_first_keys: set = set()
         # Device twin of _next_tokens: the decode chain's input vector.
         # Admissions write their first token into it with a device-side
         # .at[].set, so dispatching never needs the host values.
@@ -184,6 +207,11 @@ class Scheduler:
             "prefill_tokens",
             "Prompt tokens prefilled per admission (prefix-cache hits "
             "excluded)", TOKEN_BUCKETS)
+        self._h_prefill_batch = reg.histogram(
+            "prefill_batch_size",
+            "Requests packed into one batched [B, Tbucket] prefill "
+            "dispatch (group admission; 1 = a lone member in its "
+            "chunk-length bucket)", BATCH_BUCKETS)
         self._h_decode_block = reg.histogram(
             "decode_block_seconds",
             "Fused decode block wall latency: dispatch to stacked "
@@ -246,10 +274,7 @@ class Scheduler:
 
     @property
     def _all_live(self) -> List[Request]:
-        live = list(self.running)
-        if self._prefilling is not None:
-            live.append(self._prefilling)
-        return live
+        return list(self.running) + list(self._prefill_group)
 
     def unfinished_requests(self) -> List[Request]:
         """Every request that would be lost in a crash: running,
@@ -264,6 +289,7 @@ class Scheduler:
         # never block on a possibly-wedged device
         self._inflight = []
         self._pending_first = []
+        self._pending_first_keys.clear()
         for req in self.unfinished_requests():
             req.state = "cancelled"
             req.t_finish = time.monotonic()
@@ -282,12 +308,11 @@ class Scheduler:
                     pass
         self.running.clear()
         self.waiting.clear()
-        self._prefilling = None
+        self._prefill_group.clear()
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running
-                    or self._prefilling is not None)
+        return bool(self.waiting or self.running or self._prefill_group)
 
     def run_until_done(self, max_ticks: int = 100000) -> None:
         for _ in range(max_ticks):
@@ -397,88 +422,189 @@ class Scheduler:
         return None
 
     def _admit(self) -> None:
+        """Group admission: gang-admit waiting requests and run the
+        prefill group's next chunks as batched dispatches, repeating
+        while budget remains and progress is possible (a round whose
+        members all complete cheaply leaves budget for another gang)."""
         rt = self.engine.runtime
         if rt.scheduler == "static":
-            # Static batching: no interleave — admit (and fully prefill) a
-            # whole batch only once the previous batch has drained.
-            if self.running or self._prefilling is not None:
+            # Static batching: no interleave — admit (and fully prefill)
+            # whole batches only once the previous batch has drained;
+            # budget None = whole prompts at once.
+            if self.running or self._prefill_group:
                 return
-            budget = None  # unbounded: whole prompts at once
+            budget = None
         else:
             budget = max(1, rt.prefill_chunk)
-
-        while budget is None or budget > 0:
-            if self._prefilling is None:
-                if not self.waiting:
-                    return
-                slot = self._free_slot()
-                if slot is None:
-                    return
-                req = self.waiting[0]
-                # all_tokens includes output if preempted earlier; admit
-                # may attach already-cached prefix pages (prefix caching),
-                # whose tokens skip prefill entirely via the warm path.
-                cached = self.alloc.admit(slot, req.all_tokens,
-                                          len(req.all_tokens) + 1)
-                if cached is None:
-                    return  # pool exhausted; decode will free/preempt
-                self.waiting.popleft()
-                req.slot, req.state = slot, "prefilling"
-                req.prefilled = req.cached_at_admit = cached
-                self.slots[slot] = req
-                self._prefilling = req
-                self.engine.set_table_row(slot, self.alloc.pages_of(slot))
-                wait = time.monotonic() - req.t_enqueued
-                self._h_queue_wait.observe(wait)
-                if self.trace is not None:
-                    self.trace.event(req.id, "admit", slot=slot,
-                                     queue_wait_s=wait,
-                                     prefix_cache_hit_tokens=cached,
-                                     resumed=req.preemptions > 0)
-                # (no length bookkeeping for `cached` needed: the first
-                # warm chunk below runs in this same call and sets
-                # lengths[slot] = cached + len(chunk))
-
-            req = self._prefilling
-            prefix = req.all_tokens
-            end = len(prefix) if budget is None \
-                else min(len(prefix), req.prefilled + budget)
-            chunk = prefix[req.prefilled:end]
-            if self.trace is not None:
-                self.trace.event(req.id, "prefill_chunk",
-                                 start=req.prefilled, tokens=len(chunk))
-            logits = self.engine.prefill_chunk(req.slot, chunk, req.prefilled)
-            req.prefilled = end
+        while True:
+            used = self._admit_round(budget)
+            if used is None:
+                return
             if budget is not None:
-                budget -= len(chunk)
-            if req.prefilled < len(prefix):
-                return  # chunk budget spent; continue next tick
+                budget -= used
+                if budget <= 0:
+                    return
 
-            # prompt fully in cache: publish its full pages for prefix
-            # reuse (no-op without prefix caching), sample the first
-            # token ON DEVICE, start decoding. The token is fetched at
-            # the next stacked drain; even a max_new==1 request keeps
-            # its slot until then (its extra decode steps are discarded
-            # like any post-finish in-flight work).
-            self.alloc.register(req.slot, prefix)
-            self._prefilling = None
+    def _admit_round(self, budget: Optional[int]) -> Optional[int]:
+        """One gang-admission round: pull waiting requests into the
+        prefill group (bounded by free slots, pages, prefill_max_batch,
+        and the remaining token budget), pack every member's next chunk
+        under the budget FCFS, and dispatch the chunks as batched
+        [B, Tbucket] prefills bucketed by (freshness, chunk length).
+
+        Returns the number of prompt tokens dispatched, or None if no
+        progress was possible (nothing admissible and nothing to
+        prefill)."""
+        rt = self.engine.runtime
+        cap = max(1, min(rt.prefill_max_batch, self.engine.num_slots))
+        demand = sum(len(r.all_tokens) - r.prefilled
+                     for r in self._prefill_group)
+        while (self.waiting and len(self._prefill_group) < cap
+               and (budget is None or demand < budget)):
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            if self._shares_inflight_prefix(req):
+                break  # defer: a gang member is writing req's prefix
+            # all_tokens includes output if preempted earlier; admit
+            # may attach already-cached prefix pages (prefix caching),
+            # whose tokens skip prefill entirely via the warm path.
+            cached = self.alloc.admit(slot, req.all_tokens,
+                                      len(req.all_tokens) + 1)
+            if cached is None:
+                break  # pool exhausted; decode will free/preempt
+            self.waiting.popleft()
+            req.slot, req.state = slot, "prefilling"
+            req.prefilled = req.cached_at_admit = cached
+            self.slots[slot] = req
+            self._prefill_group.append(req)
+            self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+            demand += len(req.all_tokens) - cached
+            wait = time.monotonic() - req.t_enqueued
+            self._h_queue_wait.observe(wait)
+            if self.trace is not None:
+                self.trace.event(req.id, "admit", slot=slot,
+                                 queue_wait_s=wait,
+                                 prefix_cache_hit_tokens=cached,
+                                 resumed=req.preemptions > 0)
+            # (no length bookkeeping for `cached` needed: the member's
+            # first warm chunk sets lengths[slot] = cached + len(chunk))
+        if not self._prefill_group:
+            return None
+
+        # pack each member's next chunk under the budget, FCFS — members
+        # admitted earlier win budget, exactly like the old serialized
+        # admission, so carried members can't starve behind new arrivals
+        plan: List[tuple] = []  # (req, chunk, start)
+        used = 0
+        for req in self._prefill_group:
+            room = None if budget is None else budget - used
+            if room is not None and room <= 0:
+                break
+            prefix = req.all_tokens
+            end = len(prefix) if room is None \
+                else min(len(prefix), req.prefilled + room)
+            chunk = prefix[req.prefilled:end]
+            if not chunk:
+                continue
+            plan.append((req, chunk, req.prefilled))
+            used += len(chunk)
+        if not plan:
+            return None
+
+        # bucket by (freshness, padded chunk length): members sharing a
+        # bucket ride ONE [B, Tbucket] dispatch. Freshness splits the
+        # gang because `fresh` is a static program flag (flash-kernel
+        # eligibility) — a warm prefix-cache or carried member never
+        # drags cold members off the flash path.
+        hi = self.engine.cache.max_seq
+        dispatches: Dict[tuple, List[tuple]] = {}
+        for req, chunk, start in plan:
+            key = (start == 0, bucket_len(len(chunk), hi=hi))
+            dispatches.setdefault(key, []).append((req, chunk, start))
+        for (fresh, bucket), members in dispatches.items():
+            self._h_prefill_batch.observe(len(members))
+            if self.trace is not None:
+                self.trace.event(None, "prefill_batch",
+                                 members=len(members),
+                                 slots=[m[0].slot for m in members],
+                                 bucket=bucket,
+                                 tokens=sum(len(m[1]) for m in members),
+                                 fresh=fresh)
+                for req, chunk, start in members:
+                    self.trace.event(req.id, "prefill_chunk",
+                                     start=start, tokens=len(chunk))
+            logits = self.engine.prefill_batch(
+                [m[0].slot for m in members], [m[1] for m in members],
+                [m[2] for m in members])
+            done_rows, done_reqs = [], []
+            for i, (req, chunk, start) in enumerate(members):
+                req.prefilled = start + len(chunk)
+                if req.prefilled >= len(req.all_tokens):
+                    done_rows.append(i)
+                    done_reqs.append(req)
+            if done_reqs:
+                # device-side row gather: completing members' first
+                # tokens sample from THIS dispatch, no host sync
+                self._finish_prefill(done_reqs,
+                                     logits[jnp.asarray(done_rows)])
+        return used
+
+    def _shares_inflight_prefix(self, req: Request) -> bool:
+        """Prefix caching only: would `req` hit KV pages a current gang
+        member is still writing? Serialized admission accidentally
+        guaranteed that a request arriving behind a same-prefix request
+        admitted AFTER the first registered its pages — and so shared
+        them. Gang admission would put both in one group and pay the
+        shared prefix's prefill twice. Keep the guarantee deliberately:
+        if req's leading full block chain-matches an in-flight member's,
+        defer its admission one round — the member registers at
+        prefill_done and req then admits with a cache hit. FIFO order is
+        preserved (admission simply stops for the round), matching the
+        old behavior where such a request blocked behind the serialized
+        prefill anyway."""
+        if not self.engine.runtime.prefix_caching or not self._prefill_group:
+            return False
+        from butterfly_tpu.cache.prefix import chain_block_hashes
+        ps = self.alloc.page_size
+        head = chain_block_hashes(req.all_tokens, ps, 1)
+        if not head:  # shorter than one block: nothing cacheable
+            return False
+        return any(chain_block_hashes(m.all_tokens, ps, 1) == head
+                   for m in self._prefill_group)
+
+    def _finish_prefill(self, reqs: List[Request], logits) -> None:
+        """Members whose prompt is now fully in cache: publish pages for
+        prefix reuse (no-op without prefix caching), sample every
+        member's first token ON DEVICE from the shared dispatch's logits
+        [M, V] in one vectorized draw, start decoding. Tokens are
+        fetched at the next stacked drain; even a max_new==1 request
+        keeps its slot until then (its extra decode steps are discarded
+        like any post-finish in-flight work)."""
+        for req in reqs:
+            self.alloc.register(req.slot, req.all_tokens)
+            self._prefill_group.remove(req)
             req.state = "running"
             self.running.append(req)
-            self._h_prefill_tokens.observe(len(prefix) - req.cached_at_admit)
+            ran = len(req.all_tokens) - req.cached_at_admit
+            self._h_prefill_tokens.observe(ran)
             if self.trace is not None:
-                self.trace.event(req.id, "prefill_done",
-                                 tokens=len(prefix) - req.cached_at_admit,
-                                 total=len(prefix))
-            self._key, sub = jax.random.split(self._key)
-            first = sample_batched(
-                logits[None], sub,
-                np.asarray([req.temperature], np.float32),
-                self.engine.runtime_top_k, self.engine.runtime_top_p)[0]
-            base = self._next_dev if self._next_dev is not None \
-                else jnp.asarray(self._next_tokens)
-            self._next_dev = base.at[req.slot].set(first)
+                self.trace.event(req.id, "prefill_done", tokens=ran,
+                                 total=len(req.all_tokens))
+        self._key, sub = jax.random.split(self._key)
+        firsts = sample_batched(
+            logits, sub,
+            np.asarray([r.temperature for r in reqs], np.float32),
+            self.engine.runtime_top_k, self.engine.runtime_top_p)
+        base = self._next_dev if self._next_dev is not None \
+            else jnp.asarray(self._next_tokens)
+        slots_arr = np.asarray([r.slot for r in reqs], np.int32)
+        self._next_dev = base.at[slots_arr].set(firsts)
+        for i, req in enumerate(reqs):
             self._pending_first.append(
-                (req, req.preemptions, req.slot, first))
+                (req, req.preemptions, req.slot, firsts[i]))
+            self._pending_first_keys.add((req.id, req.preemptions))
 
     def _decode_block(self, k: int) -> None:
         """Dispatch ONE fused k-step decode block for the running set
@@ -506,9 +632,10 @@ class Scheduler:
             stops[req.slot] = req.stop_token
             # tokens the request may still emit: max_new minus what the
             # host has drained, minus an undrained admission-time first
-            # token (queued this tick in _pending_first)
-            pending = any(f[0] is req and f[1] == req.preemptions
-                          for f in self._pending_first)
+            # token (queued this tick in _pending_first; set lookup —
+            # the old per-runner linear scan over the pending list was
+            # O(running x pending) every block)
+            pending = (req.id, req.preemptions) in self._pending_first_keys
             budgets[req.slot] = (req.max_new_tokens - len(req.output)
                                  - int(pending))
         if not (active & (budgets > 0)).any():
@@ -601,6 +728,7 @@ class Scheduler:
             return
         pending, self._inflight = self._inflight, []
         firsts, self._pending_first = self._pending_first, []
+        self._pending_first_keys.clear()  # refreshed: all entries drain
         parts = [f[3].reshape(1) for f in firsts] + \
             [block.reshape(-1) for _, block, _, _, _ in pending]
         vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
@@ -661,8 +789,8 @@ class Scheduler:
             self.alloc.register(req.slot, req.all_tokens[:self._written(req)])
         req.state = state
         req.t_finish = time.monotonic()
-        if self._prefilling is req:  # cancelled mid-chunked-prefill
-            self._prefilling = None
+        if req in self._prefill_group:  # cancelled mid-chunked-prefill
+            self._prefill_group.remove(req)
         if req.slot is not None:
             self.alloc.release(req.slot)
             self.engine.reset_slot(req.slot)
@@ -681,8 +809,12 @@ class Scheduler:
             req.on_finish(req)
 
     def _ensure_or_preempt(self, req: Request, need_len: int) -> None:
-        """Grow req's pages; preempt the youngest runner (possibly req
-        itself) until it fits — older requests always win page pressure."""
+        """Grow req's pages; preempt the youngest live request (possibly
+        req itself) until it fits — older requests always win page
+        pressure. The victim pool includes partially-prefilled gang
+        members: a young mid-prefill admission is the cheapest eviction
+        (no generated tokens to recompute) and must not be able to
+        starve an older decoding request of pages."""
         while True:
             fresh = self.alloc.grow(req.slot, need_len)
             if fresh is not None:
@@ -690,7 +822,8 @@ class Scheduler:
                     self.engine.set_table_row(req.slot,
                                               self.alloc.pages_of(req.slot))
                 return
-            victim = max(self.running, key=lambda r: r.t_arrive)
+            victim = max(self.running + self._prefill_group,
+                         key=lambda r: r.t_arrive)
             self._preempt(victim)
             if victim is req:
                 return
@@ -708,20 +841,24 @@ class Scheduler:
         blanket -1 under-registered a full page at page boundaries)."""
         if req.state == "prefilling":
             return req.prefilled
-        if not req.output and any(
-                f[0] is req and f[1] == req.preemptions
-                for f in self._pending_first):
+        if not req.output and \
+                (req.id, req.preemptions) in self._pending_first_keys:
             return len(req.all_tokens)
         return len(req.all_tokens) - 1
 
     def _preempt(self, req: Request) -> None:
         """Recompute-style preemption: free pages, requeue at the front.
         With prefix caching the pages stay warm in the registry, so
-        readmission's "recompute" is usually a cache hit."""
+        readmission's "recompute" is usually a cache hit. The victim may
+        be a partially-prefilled gang member (state "prefilling"): its
+        prefilled-so-far pages register for reuse like any other and it
+        restarts its prompt on readmission."""
         self._c_preempt.inc()
         if self.trace is not None:
             self.trace.event(req.id, "preempt", slot=req.slot,
+                             state=req.state,
                              preemptions=req.preemptions + 1,
+                             prefilled=req.prefilled,
                              generated=len(req.output))
         # register BEFORE bumping the generation: _written's pending-
         # first-token check matches entries queued under the current one
@@ -731,7 +868,10 @@ class Scheduler:
         self.engine.reset_slot(req.slot)
         self.slots[req.slot] = None
         req.slot = None
-        self.running.remove(req)
+        if req in self.running:
+            self.running.remove(req)
+        else:
+            self._prefill_group.remove(req)
         # all_tokens (prompt + output) are recomputed on readmission
         req.state = "waiting"
         req.prefilled = 0

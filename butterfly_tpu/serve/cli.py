@@ -119,6 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "--speculate the verify rounds are "
                         "host-synchronous, so the block applies to "
                         "plain decoding only")
+    s.add_argument("--prefill-max-batch", type=positive_int, default=8,
+                   help="max waiting requests gang-admitted into ONE "
+                        "batched [B, Tbucket] prefill dispatch per "
+                        "scheduler tick (group admission). A burst of "
+                        "arrivals prefills as a group under the "
+                        "prefill-chunk token budget instead of one "
+                        "prompt per tick — the TTFT lever under bursty "
+                        "load. B buckets to powers of two clamped "
+                        "here, so raising it adds at most one compiled "
+                        "program per prompt-length bucket")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)

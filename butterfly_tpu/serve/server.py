@@ -730,7 +730,9 @@ def run_server(args) -> int:
                        kv_quant=getattr(args, "kv_quant", "none"),
                        speculative_gamma=getattr(args, "speculate", 0),
                        decode_steps_per_tick=getattr(
-                           args, "decode_steps_per_tick", 1))
+                           args, "decode_steps_per_tick", 1),
+                       prefill_max_batch=getattr(
+                           args, "prefill_max_batch", 8))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     # Tracing defaults ON for the serve entrypoint (/debug/requests is
     # the production debugging surface); --no-trace turns it off for
@@ -746,8 +748,12 @@ def run_server(args) -> int:
     # never mistakes the startup compile for a dead device.
     print("[butterfly] warming serving programs...", flush=True)
     warm_len = min(2 * rt.prefill_chunk, rt.max_seq_len - 4)
-    warms = [sched.submit([1] * max(1, warm_len), max_new_tokens=2),
-             sched.submit([1], max_new_tokens=2)]  # smallest bucket too
+    # a full gang of smallest-bucket prompts first (compiles the widest
+    # [B, 16] batched-prefill program a burst will hit), then the long
+    # chunked prompt (fresh + warm-continuation [1, T] buckets)
+    gang = max(1, min(rt.prefill_max_batch, rt.max_batch_size))
+    warms = [sched.submit([1], max_new_tokens=2) for _ in range(gang)]
+    warms.append(sched.submit([1] * max(1, warm_len), max_new_tokens=2))
     sched.run_until_done()
     assert all(w.done for w in warms)
     mesh_desc = "" if mesh is None else \

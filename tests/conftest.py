@@ -93,6 +93,16 @@ SLOW_TESTS = {
     # parity test decodes through it, incl. test_decode_steps_per_tick)
     "test_fused_block_greedy_parity",
     "test_fused_block_seeded_sampling_reproducible",
+    # batched group-prefill scenarios that compile a second scheduler
+    # or several reference engines (the fast tier still covers the gang
+    # path: prefill_max_batch defaults to 8, so every core parity test
+    # prefills through batched dispatches, and
+    # test_gang_admission_single_tick pins the one-dispatch property)
+    "test_batched_prefill_parity",
+    "test_batched_prefill_budget_and_carry",
+    "test_mixed_warm_cold_group_admission",
+    "test_preempt_partially_prefilled_group_member",
+    "test_prefill_group_member_is_preemption_victim",
 }
 
 

@@ -385,6 +385,135 @@ def test_fused_block_eos_mid_block():
     assert sched.alloc.free_pages == sched.alloc.num_pages
 
 
+# -- batched group prefill (engine.prefill_batch, ISSUE 4) ------------------
+
+
+def test_batched_prefill_parity():
+    """Tentpole contract: N requests gang-admitted and prefilled as ONE
+    [B, Tbucket] dispatch produce token-for-token the same outputs as
+    sequential single-slot prefill (prefill_max_batch=1) and as the
+    offline reference, across members with different prompt lengths."""
+    seq, params = make_sched(max_batch=4, max_seq=64, prefill_max_batch=1)
+    gang, _ = make_sched(max_batch=4, max_seq=64, prefill_max_batch=4)
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
+    want = [seq.submit(p, max_new_tokens=10) for p in prompts]
+    seq.run_until_done()
+    got = [gang.submit(p, max_new_tokens=10) for p in prompts]
+    gang.run_until_done()
+    assert [r.output for r in got] == [r.output for r in want]
+    assert want[0].output == ref_tokens(params, prompts[0], 10)
+    # the gang really was ONE dispatch of 4 (all chunks share the
+    # 16-token bucket); the sequential control was 4 dispatches of 1
+    h = gang.registry.get("prefill_batch_size")
+    assert h.count == 1 and h.sum == 4
+    h = seq.registry.get("prefill_batch_size")
+    assert h.count == 4 and h.sum == 4
+
+
+def test_gang_admission_single_tick():
+    """A burst of waiting requests is admitted AND fully prefilled in
+    one tick when budget and slots allow — the gang property that cuts
+    burst TTFT (previously: one [1, T] dispatch per prompt)."""
+    sched, _ = make_sched(max_batch=4, prefill_max_batch=4)
+    reqs = [sched.submit([i + 1, i + 2], max_new_tokens=4)
+            for i in range(4)]
+    sched.tick()
+    assert all(r.state == "running" for r in reqs)
+    assert sched.registry.get("prefill_batch_size").count == 1
+    sched.run_until_done()
+    assert all(r.state == "finished" for r in reqs)
+
+
+def test_batched_prefill_budget_and_carry():
+    """A gang whose chunk demand exceeds prefill_chunk is budget-split:
+    partially-prefilled members carry across ticks (mixing warm
+    continuation chunks with fresh admissions in later rounds) and every
+    member still matches the reference token-for-token."""
+    gang, params = make_sched(max_batch=3, max_seq=64, prefill_max_batch=3,
+                              prefill_chunk=8)
+    prompts = [list(range(2, 14)), list(range(3, 9)), [4, 2]]
+    got = [gang.submit(p, max_new_tokens=5) for p in prompts]
+    gang.run_until_done()
+    for p, r in zip(prompts, got):
+        assert r.output == ref_tokens(params, p, 5)
+
+
+def test_mixed_warm_cold_group_admission():
+    """A gang containing a prefix-cache-warm member (start > 0, rides
+    the dense warm program) and a cold member (start == 0, flash-
+    eligible fresh program) dispatches them in separate freshness
+    buckets and both match their references."""
+    sched, params = make_sched(max_batch=4, max_seq=64, page=8,
+                               prefix_caching=True, prefill_max_batch=4)
+    shared = list(range(1, 17))  # two full pages
+    r0 = sched.submit(shared + [5], max_new_tokens=4)
+    sched.run_until_done()
+    rw = sched.submit(shared + [9], max_new_tokens=6)  # warm: hits r0's pages
+    rc = sched.submit([7, 3, 2], max_new_tokens=6)     # cold
+    sched.tick()
+    assert rw.cached_at_admit == 16 and rc.cached_at_admit == 0
+    sched.run_until_done()
+    assert rw.output == ref_tokens(params, shared + [9], 6)
+    assert rc.output == ref_tokens(params, [7, 3, 2], 6)
+
+
+def test_preempt_partially_prefilled_group_member():
+    """Page pressure can evict a gang member that is only partially
+    prefilled: pages free, it requeues (prefilled reset), leaves the
+    group, and still completes correctly after readmission."""
+    sched, params = make_sched(max_batch=2, max_seq=64, prefill_chunk=4)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=8)
+    sched.tick()
+    sched.tick()
+    long_prompt = list(range(1, 17))
+    r2 = sched.submit(long_prompt, max_new_tokens=4)
+    sched.tick()
+    assert r2.state == "prefilling" and 0 < r2.prefilled < 16
+    assert r2 in sched._prefill_group
+    sched._preempt(r2)  # what _ensure_or_preempt does to the youngest
+    assert r2.state == "waiting" and r2.slot is None and r2.prefilled == 0
+    assert r2 not in sched._prefill_group
+    sched.run_until_done()
+    assert r1.output == ref_tokens(params, [5, 7, 11], 8)
+    assert r2.output == ref_tokens(params, long_prompt, 4)
+    assert sched.metrics()["preemptions_total"] == 1
+    assert sched.alloc.free_pages == sched.alloc.num_pages
+
+
+def test_prefill_group_member_is_preemption_victim():
+    """_ensure_or_preempt's victim pool includes mid-prefill gang
+    members: the youngest live request loses page pressure even if it
+    is still prefilling (it cannot starve an older decoding request)."""
+    sched, params = make_sched(max_batch=2, max_seq=32, page=4, num_pages=6,
+                               prefill_chunk=4)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=12)
+    sched.tick()
+    sched.tick()
+    # r2's admission takes 4 of the 6 pages and holds them across
+    # several prefill ticks; r1's decode growth must be able to evict it
+    r2 = sched.submit(list(range(1, 13)), max_new_tokens=4)
+    sched.run_until_done(max_ticks=300)
+    assert r1.state == "finished" and r2.state == "finished"
+    assert sched.metrics()["preemptions_total"] > 0
+    assert r1.output == ref_tokens(params, [5, 7, 11], 12)
+    assert r2.output == ref_tokens(params, list(range(1, 13)), 4)
+
+
+def test_pending_first_set_tracks_drain():
+    """The (id, preemptions)-keyed index over undrained first tokens is
+    populated at admission and refreshed (cleared) at drain time — the
+    budget computation reads it instead of scanning the pending list."""
+    sched, _ = make_sched()
+    req = sched.submit([5, 7, 11], max_new_tokens=4)
+    sched.tick()
+    assert (req.id, req.preemptions) in sched._pending_first_keys
+    assert len(sched._pending_first) == 1
+    sched.tick()  # stacked drain consumed the first token
+    assert not sched._pending_first_keys
+    assert not sched._pending_first
+    sched.run_until_done()
+
+
 # -- tracing + instrument wiring (obs/trace.py, obs/registry.py) ------------
 
 def test_scheduler_trace_timeline():

@@ -11,8 +11,14 @@ Scheduler tick's k-step jitted scan (engine._decode_scan) over the paged
 pool, warmed through real admissions so the trace window holds exactly
 one block dispatch.
 
+`--prefill` traces one batched [B, Tbucket] prefill dispatch
+(engine.prefill_batch): a gang of waiting requests is admitted inside
+the trace window after the program compiled off the clock — the
+admission-path twin of --serving.
+
 Usage: python tools/profile_decode.py [--max-new N] [--out DIR]
        python tools/profile_decode.py --serving [--steps-per-tick K]
+       python tools/profile_decode.py --prefill [--prefill-max-batch B]
 """
 from __future__ import annotations
 
@@ -42,6 +48,15 @@ def main() -> int:
     ap.add_argument("--steps-per-tick", type=int, default=16,
                     help="fused block width for --serving (matches "
                          "RuntimeConfig.decode_steps_per_tick)")
+    ap.add_argument("--prefill", action="store_true",
+                    help="trace one batched [B, Tbucket] prefill "
+                         "dispatch (group admission, "
+                         "engine.prefill_batch) instead of a decode "
+                         "program")
+    ap.add_argument("--prefill-max-batch", type=int, default=8,
+                    help="gang width for --prefill (matches "
+                         "RuntimeConfig.prefill_max_batch; clamped to "
+                         "--batch)")
     args = ap.parse_args()
 
     import jax
@@ -76,6 +91,8 @@ def main() -> int:
     params = init_params_quantized(cfg, jax.random.PRNGKey(0)) if on_tpu \
         else quantize_int8(model.init(jax.random.PRNGKey(0)), cfg)
     kv_quant = "int8" if on_tpu else "none"
+    if args.prefill:
+        return _profile_prefill_batch(args, model, params, kv_quant)
     if args.serving:
         return _profile_serving_block(args, model, params, kv_quant)
     engine = InferenceEngine(
@@ -149,7 +166,7 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
                      max_new_tokens=args.max_new)
     # warm until every submission is admitted and decoding (compiles the
     # prefill buckets + the k-step block program off the clock)
-    while sched.waiting or sched._prefilling is not None:
+    while sched.waiting or sched._prefill_group:
         sched.tick()
     sched.tick()
     sched._drain_inflight()
@@ -165,6 +182,51 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
     jax.profiler.start_trace(logdir)
     sched._decode_block(k)
     jax.block_until_ready(sched._inflight[-1][1])
+    jax.profiler.stop_trace()
+    sched.run_until_done(max_ticks=10 ** 6)
+    return _report(logdir, args.top)
+
+
+def _profile_prefill_batch(args, model, params, kv_quant: str) -> int:
+    """Trace ONE batched prefill dispatch (ISSUE 4): the [B, Tbucket]
+    gang-admission program is compiled off the clock by a warmup batch,
+    then a fresh gang of B waiting requests is admitted inside the trace
+    window — exactly one engine.prefill_batch dispatch, including the
+    pool scatters and the per-row start/length masking."""
+    import jax
+    import numpy as np
+
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    cfg = model.cfg
+    B = max(1, min(args.prefill_max_batch, args.batch))
+    # prefill_chunk sized so the whole gang's prompts fit one round:
+    # the traced window then holds ONE [B, Tbucket] dispatch
+    rt = RuntimeConfig(max_batch_size=args.batch,
+                       max_seq_len=args.prompt_len + args.max_new + 16,
+                       kv_quant=kv_quant, prefill_max_batch=B,
+                       prefill_chunk=max(512, args.prompt_len * B))
+    engine = ServingEngine(model, params, rt)
+    sched = Scheduler(engine)
+    rng = np.random.RandomState(0)
+
+    def prompt():
+        return rng.randint(1, cfg.vocab_size, (args.prompt_len,)).tolist()
+
+    # warmup gang: compiles the (B-bucket, T-bucket) prefill program
+    # (and the decode program the post-trace drain uses) off the clock
+    for _ in range(B):
+        sched.submit(prompt(), max_new_tokens=2)
+    sched.run_until_done()
+    for _ in range(B):
+        sched.submit(prompt(), max_new_tokens=2)
+    jax.block_until_ready(engine.cache.lengths)
+    logdir = args.out or tempfile.mkdtemp(prefix="prefill_batch_trace_")
+    jax.profiler.start_trace(logdir)
+    sched._admit()  # ONE gang admission: the batched prefill dispatch
+    jax.block_until_ready(engine.cache.k_pages)
     jax.profiler.stop_trace()
     sched.run_until_done(max_ticks=10 ** 6)
     return _report(logdir, args.top)
