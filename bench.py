@@ -52,7 +52,13 @@ def main() -> int:
     else:
         cfg = tiny("llama", dtype="float32", param_dtype="float32")
         batch, prompt_len, max_new = 4, 32, 32
-        serving_kw = dict(n_requests=6, prompt_len=16, max_new=8,
+        # max_new=32 (was 8): with k=4 fused blocks an 8-token request
+        # lives ~2 blocks — all admission/finish barriers, no steady
+        # state — so the smoke couldn't see decode-loop changes at all.
+        # 32 gives ~8 blocks of steady decoding per request, enough for
+        # the dispatch-ahead pipeline to show up in the sync-vs-
+        # pipelined comparison below.
+        serving_kw = dict(n_requests=8, prompt_len=16, max_new=32,
                           max_batch=4, decode_steps_per_tick=4,
                           prefill_max_batch=4)
         baseline_key = "cpu"
@@ -74,12 +80,28 @@ def main() -> int:
     stats = run_decode_benchmark(model, params, batch=batch,
                                  prompt_len=prompt_len, max_new=max_new,
                                  kv_quant=kv_quant)
+    # Serving path at BOTH dispatch-ahead depths, same operating point:
+    # inflight_blocks=1 is the synchronous drain-every-tick loop (the
+    # "before"), the default depth keeps blocks in flight so host
+    # scheduling overlaps device compute (the "after"). The headline
+    # serving_* keys come from the pipelined run; the synchronous run's
+    # throughput/gap ride along under a _sync suffix so the JSON line
+    # carries the before/after comparison directly.
+    serving_sync = run_serving_benchmark(
+        model, params, kv_quant="int8" if on_tpu else "none",
+        inflight_blocks=1,
+        isolated_decode_tok_s_chip=stats["decode_tokens_per_sec_per_chip"],
+        **serving_kw)
     serving = run_serving_benchmark(
         model, params, kv_quant="int8" if on_tpu else "none",
         # serving_gap (serving / isolated tok/s/chip) rides the serving
         # JSON so the trajectory tracks the gap this path is closing
         isolated_decode_tok_s_chip=stats["decode_tokens_per_sec_per_chip"],
         **serving_kw)
+    for k in ("serving_tokens_per_sec_per_chip",
+              "serving_capacity_tokens_per_sec", "serving_gap"):
+        if k in serving_sync:
+            serving[k + "_sync"] = serving_sync[k]
     toks_per_sec_chip = stats["tokens_per_sec_per_chip"]
 
     vs = 1.0

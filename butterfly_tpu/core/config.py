@@ -200,6 +200,15 @@ class RuntimeConfig:
                                       # iterations per tick() inside ONE
                                       # jitted scan (one dispatch + one
                                       # stacked drain per tick)
+    inflight_blocks: int = 2          # decode blocks kept IN FLIGHT on
+                                      # the device: block t+1 chains on
+                                      # block t's device-resident carry
+                                      # before t is drained, so host
+                                      # scheduling overlaps device
+                                      # compute (dispatch-ahead). 1 =
+                                      # the synchronous drain-every-tick
+                                      # loop; membership changes force a
+                                      # drain barrier regardless
     prefix_caching: bool = False      # content-hash KV page reuse across
                                       # requests (cache/prefix.py): shared
                                       # prompt prefixes skip prefill entirely

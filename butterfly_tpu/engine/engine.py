@@ -16,6 +16,7 @@ Realizes the reference's planned "Distributed Inference Engine"
 """
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
@@ -334,17 +335,37 @@ class InferenceEngine:
             cur = sample(logits[:, true_len - 1, :], first_key, sp)
             # replicated suffix cache sized for the whole decode run
             suffix = init_cache(self.cfg, 1, sp.max_new_tokens)
-            out = [int(np.asarray(cur)[0])]
+            # Dispatch-ahead decode: keep up to runtime.inflight_blocks
+            # sp_decode_step dispatches chained on the DEVICE token
+            # before reading any back — the per-token int(np.asarray)
+            # round trip otherwise serializes host and device every
+            # step (the serving scheduler's _inflight pattern, single-
+            # sequence edition). Positions depend only on the dispatch
+            # count, never on token values, so dispatching runs ahead
+            # of the host's stop-token check; tokens dispatched past a
+            # stop are discarded at drain, and the dispatch count is
+            # bounded by max_new_tokens - 1 so the suffix cache cannot
+            # overflow.
+            depth = max(1, self.runtime.inflight_blocks)
+            pending = deque([cur])
+            out: List[int] = []
+            n_disp = 0  # decode steps dispatched so far
             key = loop_key
-            while len(out) < sp.max_new_tokens and \
-                    not (sp.stop_token >= 0 and out[-1] == sp.stop_token):
-                positions = jnp.asarray([[true_len + len(out) - 1]],
-                                        jnp.int32)
-                logits, suffix = step(self.params, cur[:, None], positions,
-                                      prefix, suffix, plen)
-                key, sub = jax.random.split(key)
-                cur = sample(logits, sub, sp)
-                out.append(int(np.asarray(cur)[0]))
+            while pending:
+                while len(pending) <= depth and \
+                        n_disp < sp.max_new_tokens - 1:
+                    positions = jnp.asarray([[true_len + n_disp]],
+                                            jnp.int32)
+                    logits, suffix = step(self.params, cur[:, None],
+                                          positions, prefix, suffix, plen)
+                    key, sub = jax.random.split(key)
+                    cur = sample(logits, sub, sp)
+                    pending.append(cur)
+                    n_disp += 1
+                tok = int(np.asarray(pending.popleft())[0])
+                out.append(tok)
+                if sp.stop_token >= 0 and tok == sp.stop_token:
+                    break  # in-flight steps past the stop are discarded
 
         toks = np.asarray(out, np.int32)[None]
         lens = _stop_lengths(toks, sp.stop_token)

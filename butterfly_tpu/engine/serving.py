@@ -22,7 +22,12 @@ recompile (SURVEY.md §7 "hard parts"):
   per scheduler tick instead of k. Per-step RNG keys are derived on
   device (`fold_in`), and per-slot stop ids + remaining-token budgets
   ride the carry so a slot that finishes mid-block goes dead on device
-  (no further writes, no length growth, frozen tokens).
+  (no further writes, no length growth, frozen tokens). The returned
+  final-token carry is the dispatch-ahead contract: the scheduler
+  chains block t+1 on it BEFORE draining block t (up to
+  RuntimeConfig.inflight_blocks undrained), so the device runs blocks
+  back-to-back while the host schedules; a dead slot's carry stays
+  frozen at its stop id, which starts it dead in every later block.
 
 Parity contract: tests/test_sched.py and tests/test_serving_mesh.py check
 token-for-token equality with InferenceEngine.generate on the contiguous

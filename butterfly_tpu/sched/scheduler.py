@@ -8,20 +8,27 @@ BASELINE.json configs[4] serving shape.
 Host-side policy over the static-shape device programs in
 engine/serving.py:
 
-* tick() = [≤ prefill_chunk tokens of GROUP prefill work — waiting
-  requests are gang-admitted, up to prefill_max_batch of them, and
-  their next chunks run as batched [B, Tbucket] dispatches
-  (engine.prefill_batch), bucketed by chunk length] then [ONE fused
-  decode block of decode_steps_per_tick iterations for all active
-  slots — a single jitted scan, engine._decode_scan]. Nothing in
-  between forces a host sync: prefill logits stay device-resident,
-  first tokens sample on device, and the decode block chains on the
-  device token vector — prefill and decode pipeline within the tick.
-  Long prompts are split into prefill_chunk-sized pieces that continue
-  the warm cache across ticks (partially-prefilled gang members carry
-  over), so a max-length admission can never head-of-line-block
-  decoding requests for more than one chunk, and a burst of arrivals
-  prefills as a group instead of one prompt per tick.
+* tick() = [lazy drain — the OLDEST in-flight decode block only, and
+  only when the in-flight queue is full] then [≤ prefill_chunk tokens
+  of GROUP prefill work — waiting requests are gang-admitted, up to
+  prefill_max_batch of them, and their next chunks run as batched
+  [B, Tbucket] dispatches (engine.prefill_batch), bucketed by chunk
+  length] then [ONE fused decode block of decode_steps_per_tick
+  iterations for all active slots — a single jitted scan,
+  engine._decode_scan — CHAINED on the previous block's
+  device-resident carry]. Up to RuntimeConfig.inflight_blocks decode
+  blocks stay in flight (dispatch-ahead): block t+1 is dispatched
+  before block t is drained, so the tick's host section — admission,
+  operand assembly, the stacked fetch itself — overlaps the device
+  computing earlier blocks instead of idling it. A membership change
+  (admission work, a finish surfacing at drain, preemption, cancel,
+  speculative rounds) forces a FULL drain barrier so host and device
+  bookkeeping reconcile before the next dispatch. Long prompts are
+  split into prefill_chunk-sized pieces that continue the warm cache
+  across ticks (partially-prefilled gang members carry over), so a
+  max-length admission can never head-of-line-block decoding requests
+  for more than one chunk, and a burst of arrivals prefills as a
+  group instead of one prompt per tick.
 * scheduler="static" disables interleaving: a whole batch is admitted
   (full prompts at once) only when the previous batch has fully drained —
   the classic throughput-oriented static-batching mode.
@@ -51,6 +58,18 @@ from butterfly_tpu.engine.serving import (
     ServingEngine, bucket_len, sample_batched)
 from butterfly_tpu.obs.registry import (
     BATCH_BUCKETS, LATENCY_BUCKETS, TOKEN_BUCKETS, MetricsRegistry)
+
+
+def _device_ready(x) -> bool:
+    """Non-blocking completion probe for a device array (jax.Array
+    .is_ready — true once the async dispatch has materialized it). On a
+    runtime without the probe, report not-ready: the device_bubble
+    metric then reads a constant 0 (silently disabled) instead of
+    claiming a bubble on every tick."""
+    try:
+        return bool(x.is_ready())
+    except AttributeError:
+        return False
 
 
 @dataclass
@@ -139,17 +158,38 @@ class Scheduler:
         self._key = jax.random.PRNGKey(seed)
         self._next_tokens = np.zeros((engine.num_slots,), np.int32)
         # In-flight fused decode blocks: [(final device token vector
-        # [S], stacked block [k, S], k, slot->request snapshot,
-        # dispatch timestamp), ...] in dispatch order. Each tick
-        # dispatches ONE jitted k-step scan (engine.decode_block_async)
-        # chained on the previous block's device-resident final tokens,
-        # and the host drains everything in ONE stacked fetch at the
-        # next tick's start. One dispatch + one fetch per tick instead
-        # of k is what closes the serving loop toward the isolated-
-        # decode ceiling (BENCH_r05: 4,156 vs 6,988 tok/s/chip) and
+        # [S], stacked block [k, S], k, slot->(request, generation)
+        # snapshot, dispatch timestamp), ...] in dispatch order. Each
+        # tick dispatches ONE jitted k-step scan
+        # (engine.decode_block_async) chained on the previous block's
+        # device-resident final tokens, and up to
+        # RuntimeConfig.inflight_blocks of them stay undrained
+        # (dispatch-ahead): the host fetches only the OLDEST block when
+        # the queue fills, so its drain + the next tick's scheduling
+        # run while the device computes the newer blocks. This is what
+        # closes the serving loop toward the isolated-decode ceiling
+        # (BENCH_r05: 320 serving vs 6,988 isolated tok/s/chip) and
         # what makes it survive high host<->device latency (the dev
         # tunnel here has ~100 ms dispatch+fetch RTT).
         self._inflight: List[tuple] = []
+        # Batch-membership epoch: bumped whenever the running set, the
+        # pending-first set, or any runner's drained output changes
+        # (admission completing, finish, preemption, any drain).
+        # _decode_block caches its host operand assembly — the
+        # active/temps/stops/base-budget arrays and the slot snapshot —
+        # keyed on it, so back-to-back blocks over an unchanged batch
+        # skip the per-slot Python rebuild and the np.asarray churn.
+        self._epoch = 0
+        self._operands_epoch = -1
+        self._operands: Optional[tuple] = None
+        # device_bubble_seconds observation points, set at tick start:
+        # host-section start time and whether the device was ALREADY
+        # idle then (the newest in-flight block's carry ready before
+        # any host work ran — exactly the gap dispatch-ahead exists to
+        # close). _decode_block observes the gap at dispatch.
+        self._t_host0 = 0.0
+        self._idle_at_host0 = False
+        self._had_inflight_at_host0 = False
         # First tokens sampled on-device at admission, not yet fetched:
         # [(req, generation=req.preemptions, slot, device scalar)].
         # Fetched with the same stacked drain (a per-admission host
@@ -214,10 +254,21 @@ class Scheduler:
             "chunk-length bucket)", BATCH_BUCKETS)
         self._h_decode_block = reg.histogram(
             "decode_block_seconds",
-            "Fused decode block wall latency: dispatch to stacked "
-            "drain (covers decode_steps_per_tick device steps plus "
-            "any host work interleaved before the next tick's drain)",
+            "Fused decode block in-flight residency: dispatch to "
+            "stacked drain (covers decode_steps_per_tick device steps "
+            "plus, under dispatch-ahead, the ticks the block waited "
+            "undrained while newer blocks ran)", LATENCY_BUCKETS)
+        self._h_bubble = reg.histogram(
+            "device_bubble_seconds",
+            "Device idle gap per dispatched decode block: 0 when the "
+            "newest in-flight block was still running as the tick's "
+            "host section began; otherwise the (lower-bound) time the "
+            "idle device waited for the next dispatch",
             LATENCY_BUCKETS)
+        self._g_inflight = reg.gauge(
+            "inflight_depth",
+            "Decode blocks in flight (dispatched, not yet drained) at "
+            "the end of the last scheduler tick")
         # latency reservoirs: both bounded to the same recent window so
         # the two adjacent metrics share time-horizon semantics (and a
         # long-lived server doesn't leak one float per request forever)
@@ -232,6 +283,10 @@ class Scheduler:
         # effective per-token rate a streaming client experiences.
         self._itls: Deque[float] = deque(maxlen=4096)
         self._itl_means: Deque[float] = deque(maxlen=4096)
+        # per-dispatch device-bubble samples (seconds; 0 = the pipeline
+        # kept the device busy through the host section) for the
+        # metrics() percentile keys bench.py reports
+        self._bubbles: Deque[float] = deque(maxlen=4096)
 
     # -- public API ---------------------------------------------------------
 
@@ -265,9 +320,18 @@ class Scheduler:
         return req
 
     def cancel(self, req: Request) -> None:
-        """Abort a request (e.g. client disconnect): frees slot + pages."""
+        """Abort a request (e.g. client disconnect): frees slot + pages.
+
+        With decode blocks in flight a FULL drain barrier runs first:
+        the blocks were dispatched with this request's slot live, and
+        its pages must not be reclaimed (and possibly handed to a later
+        admission) while device writes to them are still outstanding."""
         if req.done:
             return
+        if req.slot is not None and (self._inflight or self._pending_first):
+            self._drain_inflight()
+            if req.done:
+                return  # the drain surfaced a natural finish
         if req in self.waiting:
             self.waiting.remove(req)
         self._finish(req, state="cancelled")
@@ -290,6 +354,7 @@ class Scheduler:
         self._inflight = []
         self._pending_first = []
         self._pending_first_keys.clear()
+        self._epoch += 1  # cached decode operands are now stale
         for req in self.unfinished_requests():
             req.state = "cancelled"
             req.t_finish = time.monotonic()
@@ -322,23 +387,53 @@ class Scheduler:
         raise RuntimeError("scheduler did not drain")
 
     def tick(self) -> int:
-        """One scheduling round: bounded prefill work, then a decode block.
+        """One scheduling round: lazy drain, bounded prefill work, then
+        a dispatch-ahead decode block.
 
-        Continuous mode interleaves at most `prefill_chunk` prompt tokens
-        of (possibly partial) prefill with ONE fused decode block of
-        `decode_steps_per_tick` iterations (a single jitted scan —
-        _decode_block), bounding every decoding request's inter-token
-        gap under admission pressure. Returns the number of tokens
-        generated this round (throughput accounting for the serve
-        loop)."""
+        Continuous mode keeps up to `RuntimeConfig.inflight_blocks`
+        fused decode blocks in flight: block t+1 chains on block t's
+        device-resident carry BEFORE t is drained, so this tick's host
+        section — drain bookkeeping, admission, operand assembly —
+        overlaps the device computing earlier blocks instead of idling
+        it (the BENCH_r05 serving gap). Draining is lazy: only the
+        oldest block is fetched, and only once the in-flight queue is
+        full; a FULL barrier (everything drained) runs only when host
+        and device state must reconcile:
+
+        * admission can make progress (a mid-prefill group, or a waiter
+          with a free slot) — prefill bookkeeping and budget assembly
+          need every in-flight token on the host;
+        * a finish surfaced at a lazy drain — the freed slot/pages and
+          the shrunken batch must be visible before the next dispatch;
+        * page pressure (_ensure_or_preempt) — preemption must never
+          reclaim pages a dispatched block still writes;
+        * cancel() — same hazard, external trigger;
+        * speculative mode — every round is host-synchronous by nature.
+
+        Returns the number of tokens generated this round (throughput
+        accounting for the serve loop)."""
         before = self._c_tokens.value
-        # consume any block still in flight BEFORE admission: admission
-        # must see finished slots, and a prefill dispatched over a stale
-        # in-flight block would race the table sync
-        self._drain_inflight()
+        rt = self.engine.runtime
+        spec = rt.speculative_gamma > 0
+        k = max(1, rt.decode_steps_per_tick)
+        depth = max(1, rt.inflight_blocks)
+        self._t_host0 = time.monotonic()
+        self._had_inflight_at_host0 = bool(self._inflight)
+        self._idle_at_host0 = self._had_inflight_at_host0 and \
+            _device_ready(self._inflight[-1][0])
+        # lazy drain: consume the oldest block once the queue is full
+        # (depth=1 degenerates to the old drain-every-tick loop). A
+        # finish surfacing there is a membership change -> full barrier.
+        while not spec and len(self._inflight) >= depth:
+            if self._drain_oldest():
+                self._drain_inflight()
+        # admission barrier — only when admission can actually make
+        # progress, so a standing queue behind full slots doesn't
+        # serialize the pipeline
+        if self._prefill_group or (self.waiting
+                                   and self._free_slot() is not None):
+            self._drain_inflight()
         self._admit()
-        spec = self.engine.runtime.speculative_gamma > 0
-        k = max(1, self.engine.runtime.decode_steps_per_tick)
         if self.running:
             self._h_batch.observe(len(self.running))
         if spec:
@@ -349,19 +444,28 @@ class Scheduler:
                 if self.running:
                     self._spec_step()
         else:
-            # Preallocate the whole block's pages up front: the fused
-            # scan's k steps then find capacity already there, so the
-            # block table dirties (and syncs to the device) at most
-            # once per TICK — measured as a large share of the
-            # full-batch serving gap (docs/decode_profile_r5.md
-            # capacity section). k+1 = chain token + k new samples —
-            # any more would add spurious page pressure in a tight pool
+            # Preallocate pages for every step still in flight PLUS
+            # this block up front: device lengths run ahead of the host
+            # mirror by up to k per undrained block, so the horizon is
+            # (inflight+1)*k + 1 (chain token + the new samples) — and
+            # the block table dirties (syncs to the device) at most
+            # once per TICK (docs/decode_profile_r5.md capacity
+            # section). Any more would add spurious page pressure in a
+            # tight pool; under pressure _ensure_or_preempt falls back
+            # to a drain barrier before it ever preempts.
+            horizon = (len(self._inflight) + 1) * k + 1
             for req in list(self.running):
                 if req in self.running:
-                    need = min(len(req.all_tokens) + k + 1,
+                    need = min(len(req.all_tokens) + horizon,
                                len(req.prompt) + req.max_new_tokens)
                     self._ensure_or_preempt(req, need)
-            self._decode_block(k)
+            if not self._decode_block(k) and \
+                    (self._inflight or self._pending_first):
+                # nothing dispatchable (every budget is spent on
+                # device): the remaining tokens exist only in flight —
+                # fetch them now or the loop would spin forever
+                self._drain_inflight()
+        self._g_inflight.set(len(self._inflight))
         made = int(self._c_tokens.value - before)
         if self.trace is not None:
             # one global event per tick: the decode batch this round —
@@ -370,6 +474,7 @@ class Scheduler:
                              batch=len(self.running),
                              waiting=len(self.waiting),
                              steps=k, block_steps=0 if spec else k,
+                             inflight=len(self._inflight),
                              generated=made)
         return made
 
@@ -411,6 +516,14 @@ class Scheduler:
             a = np.asarray(self._itl_means)
             m["itl_req_mean_p50"] = float(np.percentile(a, 50))
             m["itl_req_mean_p95"] = float(np.percentile(a, 95))
+        m["inflight_depth"] = float(self._g_inflight.value)
+        if self._bubbles:
+            # device idle per dispatched block (0 = pipeline kept the
+            # device busy through the tick's host section): the number
+            # dispatch-ahead exists to drive to ~0
+            a = np.asarray(self._bubbles)
+            m["device_bubble_p50"] = float(np.percentile(a, 50))
+            m["device_bubble_p95"] = float(np.percentile(a, 95))
         return m
 
     # -- internals ----------------------------------------------------------
@@ -605,41 +718,68 @@ class Scheduler:
             self._pending_first.append(
                 (req, req.preemptions, req.slot, firsts[i]))
             self._pending_first_keys.add((req.id, req.preemptions))
+        self._epoch += 1  # running set + pending-first set changed
 
-    def _decode_block(self, k: int) -> None:
+    def _decode_block(self, k: int) -> bool:
         """Dispatch ONE fused k-step decode block for the running set
-        (engine.decode_block_async). Host work — operand assembly, the
+        (engine.decode_block_async), chained on the previous block's
+        device-resident carry — the previous block need NOT be drained
+        first (dispatch-ahead). Host work — operand assembly, the
         jnp.asarray conversions, the RNG split, the dispatch itself —
-        is paid once per BLOCK instead of once per token; page growth
-        happened at tick start (the len+k+1 preallocation covers every
-        step of the scan).
+        is paid once per BLOCK instead of once per token, and the
+        operand assembly itself is cached on the batch-membership
+        epoch: back-to-back blocks over an unchanged batch reuse the
+        active/temps/stops arrays and the slot snapshot, refreshing
+        only the budget vector (base minus the steps already in
+        flight — the device decrements its own copy inside each scan,
+        so the host estimate must run ahead the same way). Page growth
+        happened at tick start (the len + (inflight+1)*k + 1
+        preallocation covers every step of every undrained scan).
 
         Per-slot stop ids and remaining-token budgets ride into the
         scan so a slot that finishes mid-block is masked ON DEVICE
         (lengths freeze, writes land on the null page) rather than
-        generating garbage the drain discards.
+        generating garbage the drain discards; a finished slot's chain
+        token stays frozen at its stop id, so every later in-flight
+        block starts it dead too.
+
+        Returns True iff a block was dispatched.
         """
         if not self.running:
-            return
+            return False
         S = self.engine.num_slots
-        active = np.zeros((S,), bool)
-        temps = np.zeros((S,), np.float32)
-        stops = np.full((S,), -1, np.int32)
-        budgets = np.zeros((S,), np.int32)
-        for req in self.running:
-            active[req.slot] = True
-            temps[req.slot] = req.temperature
-            stops[req.slot] = req.stop_token
-            # tokens the request may still emit: max_new minus what the
-            # host has drained, minus an undrained admission-time first
-            # token (queued this tick in _pending_first; set lookup —
-            # the old per-runner linear scan over the pending list was
-            # O(running x pending) every block)
-            pending = (req.id, req.preemptions) in self._pending_first_keys
-            budgets[req.slot] = (req.max_new_tokens - len(req.output)
-                                 - int(pending))
+        if self._operands_epoch != self._epoch:
+            active = np.zeros((S,), bool)
+            temps = np.zeros((S,), np.float32)
+            stops = np.full((S,), -1, np.int32)
+            base = np.zeros((S,), np.int32)
+            for req in self.running:
+                active[req.slot] = True
+                temps[req.slot] = req.temperature
+                stops[req.slot] = req.stop_token
+                # tokens the request may still emit: max_new minus what
+                # the host has drained, minus an undrained
+                # admission-time first token (queued in _pending_first;
+                # set lookup — the old per-runner linear scan over the
+                # pending list was O(running x pending) every block)
+                pending = (req.id,
+                           req.preemptions) in self._pending_first_keys
+                base[req.slot] = (req.max_new_tokens - len(req.output)
+                                  - int(pending))
+            self._operands = (active, temps, stops, base,
+                              {req.slot: (req, req.preemptions)
+                               for req in self.running})
+            self._operands_epoch = self._epoch
+        active, temps, stops, base, snapshot = self._operands
+        # steps dispatched but undrained: the device consumed (at most)
+        # this much of each live slot's budget already. A slot that
+        # went dead early consumed less, but its chain token is frozen
+        # at its stop id (or its budget is genuinely spent), so
+        # under-budgeting it cannot drop real tokens.
+        ahead = sum(e[2] for e in self._inflight)
+        budgets = np.maximum(base - ahead, 0) if ahead else base
         if not (active & (budgets > 0)).any():
-            return  # every runner is out of budget: nothing to decode
+            return False  # every runner is out of budget on device
         self._key, sub = jax.random.split(self._key)
         # chain on the device token vector admissions write into (which
         # the previous block's final vector seeded); the host vector
@@ -649,9 +789,19 @@ class Scheduler:
         block, final = self.engine.decode_block_async(
             cur, active, temps, stops, budgets, sub, k)
         self._next_dev = final
-        self._inflight.append(
-            (final, block, k, {req.slot: req for req in self.running},
-             time.monotonic()))
+        self._inflight.append((final, block, k, snapshot, time.monotonic()))
+        if self._idle_at_host0:
+            # the newest in-flight carry was already materialized when
+            # this tick's host section began: the device sat idle
+            # through all of it — the bubble dispatch-ahead closes
+            bubble = time.monotonic() - self._t_host0
+            self._h_bubble.observe(bubble)
+            self._bubbles.append(bubble)
+        elif self._had_inflight_at_host0:
+            self._h_bubble.observe(0.0)
+            self._bubbles.append(0.0)
+        self._idle_at_host0 = self._had_inflight_at_host0 = False
+        return True
 
     def _spec_step(self) -> None:
         """One speculative round: per-slot prompt-lookup drafts, ONE
@@ -710,27 +860,46 @@ class Scheduler:
                 self._next_tokens[slot] = req.output[-1]
         self.engine.fix_lengths(mask, vals)
 
-    def _drain_inflight(self) -> None:
-        """Read every pending first token and in-flight decode block
-        (ONE stacked device fetch) and do their host bookkeeping in
-        chronological order: firsts were queued at admission, before
-        any of the currently in-flight blocks were dispatched; each
-        block's [k, S] rows are emitted in step order, truncated per
-        request at its stop token / max_new by _emit.
+    def _drain_inflight(self) -> bool:
+        """FULL drain barrier: fetch every pending first token and
+        in-flight decode block in ONE stacked device read. Returns True
+        if any request finished."""
+        blocks, self._inflight = self._inflight, []
+        return self._drain_blocks(blocks)
 
-        Requests that finished or were preempted between dispatch and
-        drain have their tokens discarded; slots that went dead
-        mid-block carry frozen repeats of their last token, which the
-        done-check below skips (the device stopped their writes and
-        length growth inside the scan).
+    def _drain_oldest(self) -> bool:
+        """Lazy-drain step: fetch the pending firsts and ONLY the
+        oldest in-flight block, leaving newer blocks running on the
+        device (the dispatch-ahead overlap — the device computes block
+        t+1 while the host emits block t). Returns True if any request
+        finished (the caller escalates that to a full barrier)."""
+        if not self._inflight:
+            return self._drain_blocks([])
+        return self._drain_blocks([self._inflight.pop(0)])
+
+    def _drain_blocks(self, blocks: List[tuple]) -> bool:
+        """Fetch + emit the given decode blocks (ONE stacked device
+        fetch) and do their host bookkeeping in chronological order.
+        Pending first tokens always ride along: they are queued at an
+        admission barrier, when nothing is in flight, so they predate
+        every dispatched block; each block's [k, S] rows are then
+        emitted in step order per live slot, truncated per request at
+        its stop token / max_new by _emit.
+
+        Requests that finished, were cancelled, or were preempted
+        between dispatch and drain have their tokens discarded — the
+        generation check catches even a preemption readmitted into the
+        SAME slot. Slots that went dead mid-block carry frozen repeats
+        of their last token, which the done-break below skips (the
+        device stopped their writes and length growth inside the scan).
         """
-        if not self._inflight and not self._pending_first:
-            return
-        pending, self._inflight = self._inflight, []
         firsts, self._pending_first = self._pending_first, []
         self._pending_first_keys.clear()  # refreshed: all entries drain
+        if not blocks and not firsts:
+            return False
+        finished_before = self._c_finished.value
         parts = [f[3].reshape(1) for f in firsts] + \
-            [block.reshape(-1) for _, block, _, _, _ in pending]
+            [block.reshape(-1) for _, block, _, _, _ in blocks]
         vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
             else np.asarray(parts[0])
         now = time.monotonic()
@@ -744,16 +913,24 @@ class Scheduler:
             self._next_tokens[slot] = int(tok)
             self._emit(req, int(tok))
         off = nf
-        for _, block, k, snapshot, t_dispatch in pending:
+        for _, block, k, snapshot, t_dispatch in blocks:
             self._h_decode_block.observe(now - t_dispatch)
             rows = vals[off:off + k * S].reshape(k, S)
             off += k * S
-            for row in rows:
-                for slot, req in snapshot.items():
-                    if req.done or req.slot != slot:
-                        continue
-                    self._next_tokens[slot] = int(row[slot])
-                    self._emit(req, int(row[slot]))
+            for slot, (req, gen) in snapshot.items():
+                if req.done or req.slot != slot or req.preemptions != gen:
+                    continue
+                # ONE vectorized column slice + bulk int conversion per
+                # live slot instead of k per-element int(row[slot])
+                # casts over the whole [k, S] block (O(k*S) Python work
+                # per drain at S=32, k=16)
+                for tok in rows[:, slot].tolist():
+                    self._next_tokens[slot] = tok
+                    self._emit(req, tok)
+                    if req.done:
+                        break
+        self._epoch += 1  # outputs / pending-first changed
+        return self._c_finished.value > finished_before
 
     def _emit(self, req: Request, token: int) -> None:
         """Record one generated token; finish/stop bookkeeping."""
@@ -776,6 +953,7 @@ class Scheduler:
             self._finish(req)
 
     def _finish(self, req: Request, state: str = "finished") -> None:
+        self._epoch += 1  # batch membership changes below
         if state == "finished" and len(req.output) > 1 and \
                 req.t_first_token is not None:
             mean_gap = ((req.t_last_token - req.t_first_token)
@@ -809,19 +987,28 @@ class Scheduler:
             req.on_finish(req)
 
     def _ensure_or_preempt(self, req: Request, need_len: int) -> None:
-        """Grow req's pages; preempt the youngest live request (possibly
-        req itself) until it fits — older requests always win page
-        pressure. The victim pool includes partially-prefilled gang
-        members: a young mid-prefill admission is the cheapest eviction
-        (no generated tokens to recompute) and must not be able to
-        starve an older decoding request of pages."""
+        """Grow req's pages; under pressure with work in flight, fall
+        back to a FULL drain barrier (finishes surfaced there may free
+        enough pages — and a victim's pages must never be reclaimed
+        while a dispatched block still writes them); only then preempt
+        the youngest live request (possibly req itself) until it fits —
+        older requests always win page pressure. The victim pool
+        includes partially-prefilled gang members: a young mid-prefill
+        admission is the cheapest eviction (no generated tokens to
+        recompute) and must not be able to starve an older decoding
+        request of pages."""
         while True:
+            if req.done or req.slot is None:
+                return  # a drain barrier below finished/preempted req
             fresh = self.alloc.grow(req.slot, need_len)
             if fresh is not None:
                 if fresh:  # push the grown block table to the device
                     self.engine.set_table_row(req.slot,
                                               self.alloc.pages_of(req.slot))
                 return
+            if self._inflight or self._pending_first:
+                self._drain_inflight()
+                continue
             victim = max(self.running + self._prefill_group,
                          key=lambda r: r.t_arrive)
             self._preempt(victim)
@@ -853,6 +1040,7 @@ class Scheduler:
         be a partially-prefilled gang member (state "prefilling"): its
         prefilled-so-far pages register for reuse like any other and it
         restarts its prompt on readmission."""
+        self._epoch += 1  # batch membership changes below
         self._c_preempt.inc()
         if self.trace is not None:
             self.trace.event(req.id, "preempt", slot=req.slot,

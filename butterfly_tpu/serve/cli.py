@@ -129,6 +129,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "load. B buckets to powers of two clamped "
                         "here, so raising it adds at most one compiled "
                         "program per prompt-length bucket")
+    s.add_argument("--inflight-blocks", type=positive_int, default=2,
+                   help="decode blocks kept in flight on the device "
+                        "(dispatch-ahead): block t+1 chains on block "
+                        "t's device-resident carry before t is "
+                        "drained, so host scheduling overlaps device "
+                        "compute. 1 = the synchronous drain-every-tick "
+                        "loop; the device_bubble_seconds histogram "
+                        "shows whether the depth is enough to keep the "
+                        "device busy through a tick's host section")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)
@@ -136,6 +145,16 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--batch", type=int, default=8)
     b.add_argument("--prompt-len", type=int, default=128)
     b.add_argument("--max-new", type=int, default=128)
+    b.add_argument("--serving", action="store_true",
+                   help="also run the PRODUCT serving-path benchmark "
+                        "(Scheduler + ServingEngine under staggered "
+                        "arrivals) at this operating point and merge "
+                        "its serving_* keys into the JSON line")
+    b.add_argument("--inflight-blocks", type=positive_int, default=2,
+                   help="dispatch-ahead depth for --serving (see "
+                        "`serve --inflight-blocks`); the serving JSON "
+                        "carries device_bubble_p50/p95 so the overlap "
+                        "is measurable at this depth")
 
     # multi-replica router: fronts N `butterfly serve` replicas with
     # prefix-affinity routing + health-aware failover (router/). Loads no
@@ -339,7 +358,8 @@ def cmd_bench(args) -> int:
         print("error: --seq-parallel applies to `generate` (long-context "
               "single-sequence path)", file=sys.stderr)
         return 2
-    from butterfly_tpu.obs.benchmark import run_decode_benchmark
+    from butterfly_tpu.obs.benchmark import (run_decode_benchmark,
+                                             run_serving_benchmark)
 
     model = resolve_model(args)
     mesh = build_mesh(args)
@@ -348,6 +368,18 @@ def cmd_bench(args) -> int:
                                  prompt_len=args.prompt_len,
                                  max_new=args.max_new, mesh=mesh,
                                  kv_quant=args.kv_quant)
+    if args.serving:
+        # the serving path is single-engine: a mesh-sharded tree would
+        # need the serving mesh wiring (ServingEngine(mesh=...)); keep
+        # the CLI smoke single-chip like bench.py's driver
+        serving = run_serving_benchmark(
+            model, params, n_requests=2 * args.batch,
+            prompt_len=args.prompt_len, max_new=args.max_new,
+            max_batch=args.batch, kv_quant=args.kv_quant,
+            inflight_blocks=args.inflight_blocks,
+            isolated_decode_tok_s_chip=stats[
+                "decode_tokens_per_sec_per_chip"])
+        stats.update(serving)
     print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/sec/chip", **stats}))

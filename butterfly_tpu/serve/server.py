@@ -732,7 +732,9 @@ def run_server(args) -> int:
                        decode_steps_per_tick=getattr(
                            args, "decode_steps_per_tick", 1),
                        prefill_max_batch=getattr(
-                           args, "prefill_max_batch", 8))
+                           args, "prefill_max_batch", 8),
+                       inflight_blocks=getattr(
+                           args, "inflight_blocks", 2))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     # Tracing defaults ON for the serve entrypoint (/debug/requests is
     # the production debugging surface); --no-trace turns it off for

@@ -103,6 +103,14 @@ SLOW_TESTS = {
     "test_mixed_warm_cold_group_admission",
     "test_preempt_partially_prefilled_group_member",
     "test_prefill_group_member_is_preemption_victim",
+    # dispatch-ahead scenarios that compile a second scheduler / run a
+    # reference engine (the fast tier still covers the pipeline:
+    # inflight_blocks defaults to 2, so every core parity test decodes
+    # through it, and the cadence/cancel/barrier tests pin the lazy-
+    # drain behavior directly)
+    "test_pipelined_greedy_parity_vs_synchronous",
+    "test_pipelined_greedy_parity_fused_k8",
+    "test_pipelined_parity_under_page_pressure",
 }
 
 

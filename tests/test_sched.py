@@ -159,7 +159,8 @@ def test_chunked_prefill_parity():
 def test_chunked_prefill_interleaves_decode():
     """VERDICT r2 item 3: a long admission must not head-of-line-block a
     decoding request — its inter-token gap stays at one tick per chunk."""
-    sched, params = make_sched(max_batch=2, max_seq=64, prefill_chunk=4)
+    sched, params = make_sched(max_batch=2, max_seq=64, prefill_chunk=4,
+                               inflight_blocks=1)  # per-tick drain cadence
     r1 = sched.submit([5, 7, 11], max_new_tokens=20)
     sched.tick()
     sched.tick()  # second tick drains the first token + first decode step
@@ -194,7 +195,9 @@ def test_cancel_mid_prefill_frees_resources():
 
 
 def test_decode_steps_per_tick():
-    sched, params = make_sched(decode_steps_per_tick=3)
+    # inflight_blocks=1: the synchronous drain-every-tick cadence this
+    # test documents (the pipelined cadence has its own tests below)
+    sched, params = make_sched(decode_steps_per_tick=3, inflight_blocks=1)
     req = sched.submit([5, 7, 11], max_new_tokens=10)
     # admission samples the first token on-device and the tick's 3
     # decode steps are dispatched chained on it; everything drains in
@@ -503,7 +506,7 @@ def test_pending_first_set_tracks_drain():
     """The (id, preemptions)-keyed index over undrained first tokens is
     populated at admission and refreshed (cleared) at drain time — the
     budget computation reads it instead of scanning the pending list."""
-    sched, _ = make_sched()
+    sched, _ = make_sched(inflight_blocks=1)  # per-tick drain cadence
     req = sched.submit([5, 7, 11], max_new_tokens=4)
     sched.tick()
     assert (req.id, req.preemptions) in sched._pending_first_keys
@@ -512,6 +515,146 @@ def test_pending_first_set_tracks_drain():
     assert not sched._pending_first_keys
     assert not sched._pending_first
     sched.run_until_done()
+
+
+# -- pipelined dispatch-ahead serving (ISSUE 5) -----------------------------
+
+
+def test_pipelined_greedy_parity_vs_synchronous():
+    """Tentpole contract: inflight_blocks=2 (dispatch-ahead — block t+1
+    chained on block t's device carry before t is drained) is token-
+    for-token identical to the synchronous inflight_blocks=1 loop at
+    temperature 0, across slots with different prompts and lengths."""
+    sync, params = make_sched(max_batch=4, max_seq=64, inflight_blocks=1)
+    pipe, _ = make_sched(max_batch=4, max_seq=64, inflight_blocks=2)
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
+    want = [sync.submit(p, max_new_tokens=12) for p in prompts]
+    sync.run_until_done()
+    got = [pipe.submit(p, max_new_tokens=12) for p in prompts]
+    pipe.run_until_done()
+    assert [r.output for r in got] == [r.output for r in want]
+    # and the synchronous path itself still matches the offline engine
+    assert want[0].output == ref_tokens(params, prompts[0], 12)
+
+
+def test_pipelined_greedy_parity_fused_k8():
+    """Dispatch-ahead composed with the fused block: two k=8 scans in
+    flight produce exactly the synchronous path's tokens."""
+    sync, _ = make_sched(max_batch=4, max_seq=64, inflight_blocks=1,
+                         decode_steps_per_tick=8)
+    pipe, _ = make_sched(max_batch=4, max_seq=64, inflight_blocks=2,
+                         decode_steps_per_tick=8)
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
+    want = [sync.submit(p, max_new_tokens=20) for p in prompts]
+    sync.run_until_done()
+    got = [pipe.submit(p, max_new_tokens=20) for p in prompts]
+    pipe.run_until_done()
+    assert [r.output for r in got] == [r.output for r in want]
+
+
+def test_pipelined_lazy_drain_cadence():
+    """Steady state at inflight_blocks=2: block t+1 is dispatched while
+    block t is still undrained; the host fetches only once the queue is
+    full (the dispatch-ahead overlap, made visible by token timing)."""
+    sched, params = make_sched(decode_steps_per_tick=2, inflight_blocks=2)
+    req = sched.submit([5, 7, 11], max_new_tokens=12)
+    sched.tick()  # admit + first token (pending) + dispatch block 1
+    assert len(req.output) == 0 and len(sched._inflight) == 1
+    sched.tick()  # queue not full: block 2 chains, still nothing drained
+    assert len(req.output) == 0 and len(sched._inflight) == 2
+    sched.tick()  # queue full: drain first + block 1, dispatch block 3
+    assert len(req.output) == 3
+    assert sched.metrics()["inflight_depth"] == 2
+    sched.run_until_done()
+    assert req.output == ref_tokens(params, [5, 7, 11], 12)
+
+
+def test_pipelined_admission_forces_drain_barrier():
+    """A waiter with a free slot forces a FULL drain barrier before
+    admission: every in-flight block reconciles, then the gang admits
+    in the same tick."""
+    sched, params = make_sched(max_batch=2, inflight_blocks=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=16)
+    sched.tick()
+    sched.tick()
+    assert len(sched._inflight) == 2 and len(r1.output) == 0
+    r2 = sched.submit([3, 1], max_new_tokens=6)
+    sched.tick()
+    assert r2.state == "running"      # admitted this very tick
+    assert len(r1.output) >= 3        # the barrier drained everything
+    assert len(sched._inflight) == 1  # only the fresh block remains
+    sched.run_until_done()
+    assert r1.output == ref_tokens(params, [5, 7, 11], 16)
+    assert r2.output == ref_tokens(params, [3, 1], 6)
+
+
+def test_pipelined_cancel_discards_stale_blocks():
+    """cancel() mid-pipeline: a full drain barrier runs first (pages
+    with outstanding device writes are never reclaimed), the cancelled
+    request gains no tokens afterwards, and the surviving request still
+    matches its reference."""
+    sched, params = make_sched(max_batch=2, inflight_blocks=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=30)
+    r2 = sched.submit([3, 1], max_new_tokens=8)
+    sched.tick()
+    sched.tick()
+    assert len(sched._inflight) == 2
+    sched.cancel(r1)
+    assert r1.state == "cancelled" and r1.slot is None
+    assert not sched._inflight  # the barrier consumed every block
+    n_after = len(r1.output)
+    sched.run_until_done()
+    assert len(r1.output) == n_after  # no tokens post-cancel
+    assert r2.output == ref_tokens(params, [3, 1], 8)
+    assert sched.alloc.free_pages == sched.alloc.num_pages
+
+
+def test_page_pressure_drains_before_preempting():
+    """_ensure_or_preempt under pressure with blocks in flight: the
+    FULL drain barrier runs before any victim is chosen — preemption
+    must never reclaim pages a dispatched block still writes to."""
+    sched, _ = make_sched(max_batch=2, max_seq=32, page=4, num_pages=6,
+                          inflight_blocks=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=20)
+    r2 = sched.submit([3, 1], max_new_tokens=20)
+    sched.tick()
+    sched.tick()
+    assert sched._inflight
+    # the whole pool for r1: cannot fit beside r2 -> barrier, then the
+    # youngest (r2) is preempted
+    sched._ensure_or_preempt(r1, 24)
+    assert not sched._inflight
+    assert r2.state == "waiting" and r2.preemptions == 1
+
+
+def test_pipelined_parity_under_page_pressure():
+    """Tiny pool at inflight_blocks=2: the widened (inflight+1)*k+1
+    preallocation horizon falls back to drain barriers and recompute
+    preemption under pressure, and both requests still match their
+    references token-for-token."""
+    sched, params = make_sched(max_batch=2, max_seq=32, page=4, num_pages=6,
+                               inflight_blocks=2, decode_steps_per_tick=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=10)
+    r2 = sched.submit([3, 1], max_new_tokens=10)
+    sched.run_until_done(max_ticks=500)
+    assert r1.state == "finished" and r2.state == "finished"
+    assert sched.metrics()["preemptions_total"] > 0
+    assert r1.output == ref_tokens(params, [5, 7, 11], 10)
+    assert r2.output == ref_tokens(params, [3, 1], 10)
+
+
+def test_pipelined_metrics_surface():
+    """The dispatch-ahead observability contract: inflight_depth gauge
+    and device_bubble_seconds histogram/percentiles populate once
+    blocks pipeline."""
+    sched, _ = make_sched(inflight_blocks=2)
+    sched.submit([5, 7, 11], max_new_tokens=8)
+    sched.run_until_done()
+    m = sched.metrics()
+    assert "inflight_depth" in m
+    assert m.get("device_bubble_p50", 0.0) >= 0.0
+    assert sched.registry.get("device_bubble_seconds").count >= 1
+    assert sched.registry.get("inflight_depth") is not None
 
 
 # -- tracing + instrument wiring (obs/trace.py, obs/registry.py) ------------
@@ -592,7 +735,8 @@ def test_written_counts_undrained_first_token():
     on-device but before the stacked drain, every prompt token's K/V is
     written — _written must not subtract one (it loses a page of
     prefix-cache registration at page boundaries)."""
-    sched, _ = make_sched(max_batch=2, max_seq=64, page=8)
+    sched, _ = make_sched(max_batch=2, max_seq=64, page=8,
+                          inflight_blocks=1)  # per-tick drain cadence
     req = sched.submit([1] * 8, max_new_tokens=4)  # exactly one page
     sched.tick()  # admit + prefill + on-device first sample (undrained)
     assert req.state == "running" and req.output == []

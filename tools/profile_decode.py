@@ -16,9 +16,15 @@ one block dispatch.
 the trace window after the program compiled off the clock — the
 admission-path twin of --serving.
 
+`--pipeline` traces TWO chained in-flight decode blocks (dispatch-ahead,
+ISSUE 5): block 2 is dispatched on block 1's device-resident carry
+before block 1 is drained, so the trace shows whether the device runs
+the blocks back-to-back (no bubble) while the host sits in between.
+
 Usage: python tools/profile_decode.py [--max-new N] [--out DIR]
        python tools/profile_decode.py --serving [--steps-per-tick K]
        python tools/profile_decode.py --prefill [--prefill-max-batch B]
+       python tools/profile_decode.py --pipeline [--steps-per-tick K]
 """
 from __future__ import annotations
 
@@ -57,6 +63,12 @@ def main() -> int:
                     help="gang width for --prefill (matches "
                          "RuntimeConfig.prefill_max_batch; clamped to "
                          "--batch)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="trace TWO chained in-flight serving decode "
+                         "blocks (dispatch-ahead: block 2 dispatched "
+                         "on block 1's device carry before block 1 is "
+                         "drained) — shows whether the device runs "
+                         "them back-to-back with no bubble")
     args = ap.parse_args()
 
     import jax
@@ -93,6 +105,8 @@ def main() -> int:
     kv_quant = "int8" if on_tpu else "none"
     if args.prefill:
         return _profile_prefill_batch(args, model, params, kv_quant)
+    if args.pipeline:
+        return _profile_pipeline(args, model, params, kv_quant)
     if args.serving:
         return _profile_serving_block(args, model, params, kv_quant)
     engine = InferenceEngine(
@@ -150,11 +164,15 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
 
     k = args.steps_per_tick
     cfg = model.cfg
+    # budget for the warmup blocks PLUS the traced one (a request that
+    # finishes during warmup would leave the traced dispatch a no-op —
+    # the CPU fallback's max_new=16 is smaller than one k=16 block);
     # prefill_chunk sized to admit the whole batch in one tick: the
     # warmup then costs ~3 ticks, so slots can't finish (and free)
     # before the trace window captures a FULL-batch block
+    max_new = max(args.max_new, 3 * k + 8)
     rt = RuntimeConfig(max_batch_size=args.batch,
-                       max_seq_len=args.prompt_len + args.max_new + 16,
+                       max_seq_len=args.prompt_len + max_new + 16,
                        kv_quant=kv_quant, decode_steps_per_tick=k,
                        prefill_chunk=max(512, args.prompt_len * args.batch))
     engine = ServingEngine(model, params, rt)
@@ -163,7 +181,7 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
     for _ in range(args.batch):
         sched.submit(rng.randint(1, cfg.vocab_size,
                                  (args.prompt_len,)).tolist(),
-                     max_new_tokens=args.max_new)
+                     max_new_tokens=max_new)
     # warm until every submission is admitted and decoding (compiles the
     # prefill buckets + the k-step block program off the clock)
     while sched.waiting or sched._prefill_group:
@@ -181,6 +199,62 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
     logdir = args.out or tempfile.mkdtemp(prefix="serving_block_trace_")
     jax.profiler.start_trace(logdir)
     sched._decode_block(k)
+    jax.block_until_ready(sched._inflight[-1][1])
+    jax.profiler.stop_trace()
+    sched.run_until_done(max_ticks=10 ** 6)
+    return _report(logdir, args.top)
+
+
+def _profile_pipeline(args, model, params, kv_quant: str) -> int:
+    """Trace TWO chained in-flight decode blocks (ISSUE 5 dispatch-
+    ahead): after warmup, block 1 is dispatched and block 2 is chained
+    on its device-resident carry WITHOUT draining block 1 — both land
+    inside the trace window, so the timeline shows whether the device
+    runs them back-to-back (the host work between the two dispatches
+    hides under block 1's compute) or leaves a bubble."""
+    import jax
+    import numpy as np
+
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    k = args.steps_per_tick
+    cfg = model.cfg
+    # budget for warmup (first token + one drained block) PLUS the two
+    # traced in-flight blocks — otherwise the second dispatch is a
+    # no-op once the device-side budgets are spent
+    max_new = max(args.max_new, 3 * k + 8)
+    rt = RuntimeConfig(max_batch_size=args.batch,
+                       max_seq_len=args.prompt_len + max_new + 16,
+                       kv_quant=kv_quant, decode_steps_per_tick=k,
+                       inflight_blocks=2,
+                       prefill_chunk=max(512, args.prompt_len * args.batch))
+    engine = ServingEngine(model, params, rt)
+    sched = Scheduler(engine)
+    rng = np.random.RandomState(0)
+    for _ in range(args.batch):
+        sched.submit(rng.randint(1, cfg.vocab_size,
+                                 (args.prompt_len,)).tolist(),
+                     max_new_tokens=max_new)
+    # warm until every submission decodes (compiles the prefill buckets
+    # and the k-step block program off the clock), then reconcile
+    while sched.waiting or sched._prefill_group:
+        sched.tick()
+    sched.tick()
+    sched._drain_inflight()
+    # preallocate pages for BOTH blocks so neither dispatch pays
+    # host-side growth inside the window (tick()'s (m+1)*k+1 horizon)
+    for req in list(sched.running):
+        if req in sched.running:
+            need = min(len(req.all_tokens) + 2 * k + 2,
+                       len(req.prompt) + req.max_new_tokens)
+            sched._ensure_or_preempt(req, need)
+    jax.block_until_ready(engine.cache.lengths)
+    logdir = args.out or tempfile.mkdtemp(prefix="pipeline_trace_")
+    jax.profiler.start_trace(logdir)
+    sched._decode_block(k)   # block 1
+    sched._decode_block(k)   # block 2, chained on block 1's carry
     jax.block_until_ready(sched._inflight[-1][1])
     jax.profiler.stop_trace()
     sched.run_until_done(max_ticks=10 ** 6)
