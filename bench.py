@@ -26,6 +26,7 @@ def main() -> int:
     from butterfly_tpu.core.config import llama3_8b, tiny
     from butterfly_tpu.models.common import Model
     from butterfly_tpu.obs.benchmark import (run_decode_benchmark,
+                                             run_fleet_benchmark,
                                              run_serving_benchmark)
     from butterfly_tpu.quant.int8 import init_params_quantized
 
@@ -124,6 +125,15 @@ def main() -> int:
         "mfu": round(stats["mfu"], 4),
     }
     for k, v in serving.items():
+        out[k] = round(v, 4) if isinstance(v, float) else v
+    # Fleet tier: a 2-prefill + 2-decode disaggregated topology
+    # (in-process, tiny model on BOTH platforms — the fleet numbers
+    # measure the control plane's handoff + rolling drain/restart, not
+    # the model) driven through the loadgen soak. Carries the before/
+    # after TTFT (direct vs disaggregated), the cross-replica KV
+    # transfer volume/hit-rate, and the zero-drop soak property.
+    fleet = run_fleet_benchmark("2p2d")
+    for k, v in fleet.items():
         out[k] = round(v, 4) if isinstance(v, float) else v
     print(json.dumps(out))
     return 0

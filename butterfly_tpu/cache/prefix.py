@@ -204,6 +204,50 @@ class PrefixCachingAllocator(PageAllocator):
                 freed.append(pid)
         return freed
 
+    # -- cross-replica transfer (fleet/kvtransfer.py) ------------------------
+
+    def lookup(self, h: bytes) -> Optional[int]:
+        """Registered page id for a chain digest, or None. The export
+        path resolves the requester's hash chain page-by-page; the
+        leading matched run is what ships (pages after a gap could
+        never be attached by `admit`, which stops at the first miss)."""
+        return self._entries.get(h)
+
+    def pin(self, pids: List[int]) -> None:
+        """Hold pages against eviction/recycling while their contents
+        are read out for a cross-replica transfer. Refcount-based, so a
+        pinned warm page leaves the evictable list exactly like a page
+        attached to a slot; callers MUST unpin in a finally block —
+        transfer pins are transient and are not slot holders, so
+        check_invariants only balances once they are released."""
+        for pid in pids:
+            self._incref(pid)
+
+    def unpin(self, pids: List[int]) -> None:
+        for pid in pids:
+            self._decref(pid)
+
+    def import_page(self, h: bytes) -> Optional[int]:
+        """Claim a page for externally produced K/V content keyed by
+        chain digest `h` and register it warm (refcount 0, evictable —
+        exactly the state a released registered page sits in, so a
+        later `admit` revives it as a normal prefix hit). Returns the
+        page id the caller must now write the K/V bytes into, None if
+        the digest is already cached (idempotent re-import), and raises
+        MemoryError when every page is held by a live slot. Import in
+        chain order: on MemoryError the pages already landed form a
+        leading run, which is the only shape `admit` can use."""
+        if h in self._entries:
+            return None
+        if not self._free and not self._evictable:
+            raise MemoryError("no free or evictable pages for KV import")
+        pid = self._take_free()
+        self._entries[h] = pid
+        self._page_hash[pid] = h
+        self._ref[pid] = 0
+        self._evictable[pid] = None
+        return pid
+
     # -- diagnostics ---------------------------------------------------------
 
     def check_invariants(self) -> None:

@@ -365,6 +365,51 @@ class ServingEngine:
         self.cache = cache
         return block, final
 
+    def read_pages(self, pids: list[int]) -> Tuple[np.ndarray, np.ndarray,
+                                                   Optional[np.ndarray],
+                                                   Optional[np.ndarray]]:
+        """Fetch page contents to the host for cross-replica KV export
+        (fleet/kvtransfer.py): returns (k [L, n, Kv, page, H],
+        v [L, n, Kv, page, H], k_scales, v_scales) — scales [L, n,
+        Kv*page] iff the pool is int8, else None. Synchronous device
+        read; callers hold the serving lock so the scheduler thread
+        cannot donate the pools out from under the gather, and only
+        REGISTERED pages (content-immutable — a shared full page is
+        never rewritten) may be exported, so in-flight decode blocks
+        writing other pages cannot race the bytes."""
+        idx = jnp.asarray(pids, jnp.int32)
+        with self._mesh_ctx():
+            k = np.asarray(self.cache.k_pages[:, idx])
+            v = np.asarray(self.cache.v_pages[:, idx])
+            ks = vs = None
+            if self.cache.quantized:
+                ks = np.asarray(self.cache.k_scale_pages[:, idx])
+                vs = np.asarray(self.cache.v_scale_pages[:, idx])
+        return k, v, ks, vs
+
+    def write_pages(self, pids: list[int], k: np.ndarray, v: np.ndarray,
+                    k_scales: Optional[np.ndarray] = None,
+                    v_scales: Optional[np.ndarray] = None) -> None:
+        """Land imported page contents (the read_pages layout) into the
+        local pool at freshly claimed page ids (allocator.import_page).
+        The pages are not in any slot's table row yet — a later
+        admission attaches them read-only via the prefix registry — so
+        no in-flight dispatch can be reading them while this scatter
+        runs."""
+        idx = jnp.asarray(pids, jnp.int32)
+        with self._mesh_ctx():
+            kp = self.cache.k_pages.at[:, idx].set(
+                jnp.asarray(k, self.cache.k_pages.dtype))
+            vp = self.cache.v_pages.at[:, idx].set(
+                jnp.asarray(v, self.cache.v_pages.dtype))
+            ksp, vsp = self.cache.k_scale_pages, self.cache.v_scale_pages
+            if self.cache.quantized:
+                ksp = ksp.at[:, idx].set(jnp.asarray(k_scales, jnp.float32))
+                vsp = vsp.at[:, idx].set(jnp.asarray(v_scales, jnp.float32))
+            self.cache = self.cache._replace(
+                k_pages=kp, v_pages=vp,
+                k_scale_pages=ksp, v_scale_pages=vsp)
+
     def verify_active(self, tokens: np.ndarray,
                       active: np.ndarray) -> np.ndarray:
         """Batched (gamma+1)-token greedy verify for every slot

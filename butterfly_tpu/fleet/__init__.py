@@ -1,0 +1,19 @@
+"""Fleet control plane: disaggregated prefill/decode serving.
+
+Grows the multi-replica router (butterfly_tpu/router/) into a KV-aware
+control plane (the DistServe / Mooncake architecture): prefill-heavy
+requests run on prefill-role replicas, their KV pages stream to a
+decode-role replica by content hash (fleet/kvtransfer.py over the
+prefix-cache page registry), and generation finishes there.
+
+* kvtransfer.py   — chain-hash-addressed KV page export/import payloads
+                    (the replica side of GET /kv/pages, POST /kv/import)
+* controlplane.py — the routing tier: request classification, the
+                    prefill -> transfer -> decode handoff, fleet-state
+                    polling, GET /fleet/state
+* harness.py      — in-process fleet topologies (`butterfly fleet
+                    --topology 2p2d`, the soak tests, the fleet bench)
+"""
+from butterfly_tpu.fleet.kvtransfer import export_payload, import_payload
+
+__all__ = ["export_payload", "import_payload"]

@@ -1,0 +1,441 @@
+"""Fleet control plane: the router tier grown KV-aware.
+
+Extends the multi-replica router (router/proxy.py) into the
+disaggregated prefill/decode architecture (DistServe OSDI'24 /
+Mooncake FAST'25): the compute-bound prefill phase and the
+latency-bound decode phase interfere when they share a replica — a
+long prompt's prefill stalls every decoding request's next token — so
+the control plane runs them on separate replica tiers and streams the
+KV state between them by content hash.
+
+Request path (``POST /generate``, token-id body, non-streaming):
+
+1. **classify** — predicted prefill cost = prompt tokens minus the
+   tokens expected warm on the decode tier (the affinity ring is the
+   predictor: a prefix population routed before has its shared head
+   registered on its ring target). Below ``disagg_threshold``, or for
+   string prompts (the control plane cannot compute the replicas'
+   token-block hashes without a tokenizer), streaming, or
+   ``/v1/completions``, the request dispatches DIRECT to the decode
+   tier through the inherited router proxy — affinity, failover, and
+   the single retry rule all unchanged.
+2. **prefill leg** — the request runs on a prefill-role replica with
+   ``max_tokens=1``: full prompt prefill + the first token. TTFT is
+   measured here, across the handoff.
+3. **KV transfer** — the prompt's chain hashes
+   (cache/prefix.py:chain_block_hashes — the very keys the replica
+   registries use) are exported from the prefill replica
+   (``GET /kv/pages``) and imported into the chosen decode replica
+   (``POST /kv/import``) verbatim; the pages land warm in its prefix
+   registry.
+4. **decode leg** — generation resumes on the decode replica with
+   prompt = original + first token: admission prefix-hits the imported
+   pages and prefills only the partial trailing block, then decodes to
+   budget. Greedy outputs are byte-identical to single-replica serving
+   (the warm-prefill parity contract).
+
+Every leg degrades safely: a failed export/import just means the
+decode replica prefills the whole prompt itself; a failed prefill or
+decode leg falls back to a direct dispatch (no client byte has been
+sent before the combined response). Correctness never depends on a
+transfer landing.
+
+Fleet state: the pool's existing /health probe loop now carries role,
+free_pages, and inflight_depth per replica (serve/server.py), so
+``GET /fleet/state`` and the placement decision read one table with no
+second poll path.
+
+stdlib-only, like the rest of the router tier.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from butterfly_tpu.cache.prefix import chain_block_hashes
+from butterfly_tpu.obs.registry import LATENCY_BUCKETS, MetricsRegistry
+from butterfly_tpu.router.policy import PrefixAffinityPolicy, affinity_key
+from butterfly_tpu.router.pool import Replica, ReplicaPool
+from butterfly_tpu.router.proxy import (
+    RouterState, extract_route_tokens, make_router_handler)
+
+
+class ControlPlaneState(RouterState):
+    """RouterState plus the disaggregation planner's knobs and the
+    fleet_* instrument families."""
+
+    def __init__(self, pool: ReplicaPool, policy: PrefixAffinityPolicy,
+                 registry: Optional[MetricsRegistry] = None,
+                 read_timeout: float = 300.0,
+                 disagg_threshold: int = 64,
+                 handoff_timeout: float = 60.0):
+        super().__init__(pool, policy, registry=registry,
+                         read_timeout=read_timeout)
+        self.page_size = policy.page_size
+        # predicted FRESH prefill tokens at which a request is worth
+        # the handoff (two extra HTTP round trips + the page bytes)
+        self.disagg_threshold = max(1, int(disagg_threshold))
+        self.handoff_timeout = handoff_timeout
+        # prefix populations seen before (affinity key -> True),
+        # bounded LRU: the shared head of a repeat population is
+        # expected warm on its ring target, shrinking the predicted
+        # prefill cost so repeat traffic stays on the decode tier
+        self._seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._seen_cap = 4096
+        reg = self.registry
+        self._c_disagg = reg.counter(
+            "fleet_disagg_requests_total",
+            "Requests served via the prefill->transfer->decode handoff")
+        self._c_direct = reg.counter(
+            "fleet_direct_requests_total",
+            "Requests dispatched directly to the decode tier")
+        self._c_fallback = reg.counter(
+            "fleet_disagg_fallbacks_total",
+            "Handoffs that fell back to a direct dispatch mid-flight "
+            "(prefill leg, transfer, or decode leg failed)")
+        self._c_xfer_bytes = reg.counter(
+            "fleet_kv_transfer_bytes_total",
+            "Raw KV page bytes exported across replicas")
+        self._c_xfer_pages = reg.counter(
+            "fleet_kv_transfer_pages_total",
+            "KV pages landed into decode-tier prefix registries")
+        self._c_xfer_hits = reg.counter(
+            "fleet_kv_transfer_hits_total",
+            "Requested chain hashes the prefill replica had registered")
+        self._c_xfer_miss = reg.counter(
+            "fleet_kv_transfer_misses_total",
+            "Requested chain hashes missing at export (evicted or "
+            "never registered) — the decode replica prefills those "
+            "blocks itself")
+        self._h_ttft = reg.histogram(
+            "fleet_ttft_seconds",
+            "Control-plane TTFT for disaggregated requests: client "
+            "arrival to the prefill leg's first token, across the "
+            "handoff", LATENCY_BUCKETS)
+
+    # -- planning -----------------------------------------------------------
+
+    def direct_plan(self, tokens) -> Tuple[List[Replica], Optional[str]]:
+        """Decode-tier candidates (any-role fallback when the decode
+        tier is empty/unroutable — a degraded fleet still serves)."""
+        cands, aff = self.policy.plan(tokens, role="decode")
+        if not cands:
+            cands, aff = self.policy.plan(tokens)
+        return cands, aff
+
+    def predicted_cost(self, ids: List[int]) -> int:
+        """Predicted FRESH prefill tokens: prompt length minus the
+        shared head expected warm on the decode tier (affinity-ring
+        populations seen before). A heuristic, deliberately cheap —
+        misprediction costs only placement, never correctness."""
+        key = affinity_key(ids, self.page_size, self.policy.affinity_blocks)
+        warm = 0
+        with self._mlock:
+            seen = key is not None and key in self._seen
+            if seen:
+                self._seen.move_to_end(key)
+        if seen:
+            warm = min((len(ids) - 1) // self.page_size,
+                       self.policy.affinity_blocks) * self.page_size
+        return len(ids) - warm
+
+    def note_seen(self, ids: List[int]) -> None:
+        key = affinity_key(ids, self.page_size, self.policy.affinity_blocks)
+        if key is None:
+            return
+        with self._mlock:
+            self._seen[key] = True
+            self._seen.move_to_end(key)
+            while len(self._seen) > self._seen_cap:
+                self._seen.popitem(last=False)
+
+    def observe(self, hist, v: float) -> None:
+        with self._mlock:
+            hist.observe(v)
+
+    def add(self, counter, n: float) -> None:
+        """Locked multi-increment (instruments are multi-writer here —
+        handler threads — like every RouterState update)."""
+        with self._mlock:
+            counter.inc(n)
+
+    def fleet_counters(self) -> Dict[str, float]:
+        hits = self._c_xfer_hits.value
+        miss = self._c_xfer_miss.value
+        return {
+            "disagg_requests": self._c_disagg.value,
+            "direct_requests": self._c_direct.value,
+            "disagg_fallbacks": self._c_fallback.value,
+            "kv_transfer_bytes": self._c_xfer_bytes.value,
+            "kv_transfer_pages": self._c_xfer_pages.value,
+            "kv_transfer_hits": hits,
+            "kv_transfer_misses": miss,
+            "kv_transfer_hit_rate":
+                hits / (hits + miss) if hits + miss else 0.0,
+        }
+
+    def fleet_state(self) -> Dict:
+        """The GET /fleet/state body: per-replica placement signals
+        (role, liveness, queue depth, page headroom, pipeline depth —
+        all from the ONE /health probe loop), the tier membership view
+        the planner routes by, and the fleet counters."""
+        snaps = self.pool.snapshot()
+        tiers = {
+            tier: [s["replica"] for s in snaps
+                   if s["role"] in (tier, "both")]
+            for tier in ("prefill", "decode")
+        }
+        return {"replicas": snaps, "tiers": tiers,
+                "disagg_threshold": self.disagg_threshold,
+                "metrics": self.fleet_counters()}
+
+
+def make_fleet_handler(state: ControlPlaneState):
+    """The control-plane HTTP handler: the router handler (proxy,
+    admin drain/undrain, /metrics, /router/replicas) plus /fleet/state
+    and the disaggregated dispatch path."""
+    Base = make_router_handler(state)
+
+    class FleetHandler(Base):
+
+        def do_GET(self):
+            if self.path.split("?")[0] == "/fleet/state":
+                self._json(200, state.fleet_state())
+            else:
+                Base.do_GET(self)
+
+        # -- classification ---------------------------------------------------
+
+        def _proxy(self, path: str) -> None:
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+            except (ValueError, OSError):
+                self._json(400, {"error": "unreadable body"})
+                return
+            try:
+                obj = json.loads(body or b"{}")
+            except (ValueError, UnicodeDecodeError):
+                obj = None
+            ids = self._token_ids(obj)
+            plan = self._disagg_plan(path, obj, ids)
+            if plan is None:
+                state.inc(state._c_direct)
+                if ids:
+                    state.note_seen(ids)
+                route_tokens = extract_route_tokens(body)
+                self._dispatch(path, body,
+                               *state.direct_plan(route_tokens))
+                return
+            pre, dec = plan
+            self._disaggregate(obj, ids, pre, dec)
+
+        def _token_ids(self, obj) -> Optional[List[int]]:
+            """Explicit token ids only: a string prompt would hash its
+            UTF-8 bytes, which can never match the replicas'
+            tokenized page blocks — such requests route direct."""
+            if not isinstance(obj, dict):
+                return None
+            ids = obj.get("tokens")
+            if ids is None and isinstance(obj.get("prompt"), list):
+                ids = obj["prompt"]
+            if not isinstance(ids, list) or not ids:
+                return None
+            try:
+                return [int(t) for t in ids]
+            except (ValueError, TypeError):
+                return None
+
+        def _disagg_plan(self, path, obj, ids
+                         ) -> Optional[Tuple[Replica, Replica]]:
+            """(prefill replica, decode replica) when the handoff is
+            worth it, else None -> direct dispatch."""
+            if path != "/generate" or not isinstance(obj, dict) \
+                    or obj.get("stream") or ids is None:
+                return None
+            if len(ids) < state.page_size + 1:
+                return None  # no full page to transfer
+            if state.predicted_cost(ids) < state.disagg_threshold:
+                return None
+            dec_cands, _ = state.policy.plan(ids, role="decode")
+            pre_cands, _ = state.policy.plan(ids, role="prefill")
+            if not dec_cands or not pre_cands:
+                return None
+            dec = dec_cands[0]
+            # a handoff to yourself is just a slower direct dispatch
+            pre = next((r for r in pre_cands if r.rid != dec.rid), None)
+            if pre is None:
+                return None
+            return pre, dec
+
+        # -- the handoff ------------------------------------------------------
+
+        def _call(self, rep: Replica, method: str, path: str,
+                  obj=None, timeout: Optional[float] = None):
+            """One control-plane HTTP call with pool feedback. Returns
+            (status, parsed body) — status None on transport failure."""
+            url = f"http://{rep.host}:{rep.port}{path}"
+            data = json.dumps(obj).encode() if obj is not None else None
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={"Content-Type": "application/json"})
+            state.pool.note_dispatch(rep.rid)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or state.read_timeout) as resp:
+                    return resp.status, json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read() or b"{}")
+                except (ValueError, OSError):
+                    body = {}
+                e.close()
+                if e.code == 503:
+                    state.pool.note_wedged(rep.rid, "503 during handoff")
+                return e.code, body
+            except Exception as e:  # refused / reset / timeout / bad JSON
+                state.pool.note_connect_failure(rep.rid, str(e))
+                return None, {"error": str(e)}
+            finally:
+                state.pool.note_done(rep.rid)
+
+        def _fallback(self, obj, ids) -> None:
+            """A handoff leg failed before any client byte: re-dispatch
+            the ORIGINAL request direct (the decode replica recomputes
+            the whole prompt — slower, never wrong)."""
+            state.inc(state._c_fallback)
+            body = json.dumps(obj).encode()
+            self._dispatch("/generate", body, *state.direct_plan(ids))
+
+        def _disaggregate(self, obj: dict, ids: List[int],
+                          pre: Replica, dec: Replica) -> None:
+            t0 = time.monotonic()
+            state.inc(state._c_disagg)
+            max_tokens = int(obj.get("max_tokens",
+                                     obj.get("max_new_tokens", 64)))
+            # 1. prefill leg: full prompt + first token on the prefill tier
+            a_req = {"tokens": ids, "max_tokens": 1}
+            for k in ("temperature", "stop_token", "request_id"):
+                if k in obj:
+                    a_req[k] = obj[k]
+            code, a = self._call(pre, "POST", "/generate", a_req,
+                                 timeout=state.handoff_timeout)
+            if code != 200 or not a.get("tokens"):
+                self._fallback(obj, ids)
+                return
+            ttft = time.monotonic() - t0
+            state.observe(state._h_ttft, ttft)
+            first = [int(t) for t in a["tokens"]]
+            # 2. KV transfer: the prompt's full-page chain, A -> B.
+            # Failures are absorbed — B prefills uncovered blocks itself.
+            imported = 0
+            hashes = [h.hex() for h in chain_block_hashes(ids,
+                                                          state.page_size)]
+            if hashes:
+                code, exp = self._call(
+                    pre, "GET", "/kv/pages?hashes=" + ",".join(hashes),
+                    timeout=state.handoff_timeout)
+                if code == 200:
+                    n_pages = len(exp.get("pages", ()))
+                    state.add(state._c_xfer_hits, n_pages)
+                    state.add(state._c_xfer_miss,
+                              len(exp.get("missing", ())))
+                    state.add(state._c_xfer_bytes,
+                              int(exp.get("bytes", 0)))
+                    if n_pages:
+                        code, imp = self._call(dec, "POST", "/kv/import",
+                                               exp,
+                                               timeout=state.handoff_timeout)
+                        if code == 200:
+                            # skipped = already cached on B (an earlier
+                            # transfer or B's own traffic): warm either
+                            # way, the handoff's purpose
+                            imported = int(imp.get("imported", 0)) \
+                                + int(imp.get("skipped", 0))
+                            state.add(state._c_xfer_pages, imported)
+            state.note_seen(ids)
+            meta = {"disaggregated": True, "prefill_replica": pre.rid,
+                    "decode_replica": dec.rid,
+                    "kv_pages_imported": imported, "ttft_s": ttft}
+            # 3. decode leg: prompt + first token, remaining budget.
+            # Admission on B prefix-hits the imported pages and
+            # prefills only the partial trailing block.
+            if max_tokens <= 1 or a.get("stopped"):
+                self._finish_disagg(t0, first, a.get("text", ""),
+                                    a.get("stopped", False), meta, dec.rid)
+                return
+            b_req = {"tokens": ids + first, "max_tokens": max_tokens - 1}
+            for k in ("temperature", "stop_token", "top_p", "top_k",
+                      "request_id"):
+                if k in obj:
+                    b_req[k] = obj[k]
+            code, b = self._call(dec, "POST", "/generate", b_req)
+            if code != 200:
+                self._fallback(obj, ids)
+                return
+            self._finish_disagg(
+                t0, first + [int(t) for t in b.get("tokens", ())],
+                a.get("text", "") + b.get("text", ""),
+                b.get("stopped", False), meta, dec.rid)
+
+        def _finish_disagg(self, t0, tokens, text, stopped, meta,
+                           rid) -> None:
+            state.count(rid, "ok")
+            self._json(200, {
+                "tokens": tokens, "text": text, "stopped": stopped,
+                "total_s": time.monotonic() - t0, **meta,
+            }, headers={"X-Routed-To": rid})
+
+    return FleetHandler
+
+
+def fleet_forever(backends: List[str], host: str = "0.0.0.0",
+                  port: int = 8100, page_size: int = 16,
+                  affinity_blocks: int = 4, saturate_after: int = 8,
+                  probe_interval: float = 0.5, probe_timeout: float = 2.0,
+                  dead_after: int = 3, read_timeout: float = 300.0,
+                  disagg_threshold: int = 64,
+                  ready_event=None):
+    """Blocking control-plane loop (`butterfly route --disaggregate`).
+    Same shape as router.proxy.route_forever — the control plane IS the
+    router, grown KV-aware."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    registry = MetricsRegistry()
+    pool = ReplicaPool(backends, probe_interval=probe_interval,
+                       probe_timeout=probe_timeout, dead_after=dead_after,
+                       registry=registry)
+    policy = PrefixAffinityPolicy(pool, page_size=page_size,
+                                  affinity_blocks=affinity_blocks,
+                                  saturate_after=saturate_after)
+    state = ControlPlaneState(pool, policy, registry=registry,
+                              read_timeout=read_timeout,
+                              disagg_threshold=disagg_threshold)
+    pool.probe_all()   # one synchronous round: roles known at bind
+    pool.start()
+
+    class _Server(ThreadingHTTPServer):
+        request_queue_size = 128
+
+    httpd = _Server((host, port), make_fleet_handler(state))
+    state.httpd = httpd
+    if ready_event is not None:
+        ready_event.set()
+    snaps = pool.snapshot()
+    n_pre = sum(1 for s in snaps if s["role"] in ("prefill", "both"))
+    n_dec = sum(1 for s in snaps if s["role"] in ("decode", "both"))
+    print(f"[butterfly] fleet control plane on {host}:{port}: "
+          f"{len(snaps)} replicas ({n_pre} prefill-capable, "
+          f"{n_dec} decode-capable), disagg threshold "
+          f"{state.disagg_threshold} tokens", flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        pool.stop()
+        httpd.server_close()
+    return 0

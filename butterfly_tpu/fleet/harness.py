@@ -1,0 +1,178 @@
+"""In-process fleet topologies: N prefill + M decode replicas behind
+one disaggregated control plane, all in this process.
+
+The local twin of a real deployment (`butterfly serve --role ...` x N
+behind `butterfly route --disaggregate`): each replica is a full
+Scheduler + ServingEngine + HTTP front on a loopback port, the control
+plane is the real ControlPlaneState/FleetHandler — only the network is
+loopback. Used by `butterfly fleet --topology 2p2d` (manual
+debugging), tests/test_fleet.py (the soak), and the fleet benchmark
+(obs/benchmark.py). All replicas share ONE param tree (same weights,
+as a real fleet would load from one checkpoint), which is also what
+makes cross-replica KV bytes interchangeable.
+
+``ReplicaHandle.restart()`` bounces the replica's HTTP front (the
+listener drops mid-fleet and comes back on the same port) — the
+rolling-restart half of the soak's drain/restart cycle; the drain half
+goes through the control plane's inherited /router/drain admin
+surface.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from http.server import ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.fleet.controlplane import (
+    ControlPlaneState, make_fleet_handler)
+from butterfly_tpu.obs.registry import MetricsRegistry
+from butterfly_tpu.router.policy import PrefixAffinityPolicy
+from butterfly_tpu.router.pool import ReplicaPool
+
+
+def parse_topology(spec: str) -> Tuple[int, int]:
+    """'2p2d' -> (2 prefill, 2 decode); '1p1d', '3p1d', ... Also
+    accepts '4' as shorthand for a role-less 4x'both' pool (0p0d would
+    be meaningless)."""
+    m = re.fullmatch(r"(\d+)p(\d+)d", spec.strip().lower())
+    if m:
+        n_pre, n_dec = int(m.group(1)), int(m.group(2))
+        if n_pre < 1 or n_dec < 1:
+            raise ValueError(f"topology {spec!r} needs >=1 replica per tier")
+        return n_pre, n_dec
+    if spec.strip().isdigit() and int(spec) >= 1:
+        return 0, int(spec)  # all-'both' pool
+    raise ValueError(f"unparseable topology {spec!r} (want e.g. '2p2d')")
+
+
+class ReplicaHandle:
+    def __init__(self, state, httpd, sched, role: str, host: str):
+        self.state = state
+        self.httpd = httpd
+        self.sched = sched
+        self.role = role
+        self.host = host
+        self.port = httpd.server_port
+        self.rid = f"{host}:{self.port}"
+        self.url = f"http://{self.rid}"
+
+    def restart(self) -> None:
+        """Bounce the HTTP front on the same port (connects fail for
+        the gap, exactly like a rolling binary restart of the serving
+        tier; scheduler + KV state survive, as they would behind a
+        real graceful-restart supervisor)."""
+        from butterfly_tpu.serve.server import make_handler
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.httpd = ThreadingHTTPServer((self.host, self.port),
+                                         make_handler(self.state))
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self.state.stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class FleetHandle:
+    def __init__(self, replicas: List[ReplicaHandle], cp_state, cp_httpd):
+        self.replicas = replicas
+        self.state = cp_state
+        self.httpd = cp_httpd
+        self.url = f"http://127.0.0.1:{cp_httpd.server_port}"
+        self.by_rid = {r.rid: r for r in replicas}
+
+    @property
+    def rids(self) -> List[str]:
+        return [r.rid for r in self.replicas]
+
+    def stop(self) -> None:
+        self.state.pool.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        for r in self.replicas:
+            r.stop()
+
+
+def start_replica(model, params, role: str, *, page_size: int = 8,
+                  max_batch: int = 2, max_seq: int = 128,
+                  num_pages: Optional[int] = None,
+                  host: str = "127.0.0.1", warm: bool = True,
+                  warm_len: Optional[int] = None) -> ReplicaHandle:
+    """One in-process serve replica on a fresh loopback port. Prefix
+    caching is always on — it is the registry KV transfer addresses
+    pages through. Warming runs BEFORE the scheduler loop thread
+    starts (one thread ticks a scheduler, ever)."""
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+    from butterfly_tpu.serve.server import ServerState, make_handler
+    from butterfly_tpu.utils.tokenizer import ByteTokenizer
+
+    rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
+                       page_size=page_size, num_pages=num_pages,
+                       prefix_caching=True)
+    sched = Scheduler(ServingEngine(model, params, rt))
+    if warm:
+        # compile prefill + decode off any measured clock, BOTH prefill
+        # flavors: the first warm prompt runs the fresh program, the
+        # repeat prefix-hits its registered pages and compiles the
+        # warm-continuation program the transfer handoff's tail prefill
+        # uses. warm_len should match the expected workload's prefill
+        # bucket (bucket_len) or the first measured request pays XLA.
+        wl = min(warm_len or page_size * 2, max_seq - 4)
+        for _ in range(2):
+            w = sched.submit([1] * wl, max_new_tokens=2)
+            sched.run_until_done()
+            assert w.done
+    state = ServerState(sched, ByteTokenizer(), role=role)
+    state.thread.start()
+    httpd = ThreadingHTTPServer((host, 0), make_handler(state))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return ReplicaHandle(state, httpd, sched, role, host)
+
+
+def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
+                max_batch: int = 2, max_seq: int = 128,
+                num_pages: Optional[int] = None,
+                disagg_threshold: int = 16, affinity_blocks: int = 4,
+                probe_interval: float = 0.2, model=None, params=None,
+                warm: bool = True,
+                warm_len: Optional[int] = None) -> FleetHandle:
+    """Spin the whole topology: replicas (one shared tiny-model param
+    tree unless the caller provides model+params) + control plane, and
+    optionally warm every replica's serving programs so the first
+    measured request doesn't pay the XLA compile."""
+    import jax
+    from butterfly_tpu.models.common import Model
+
+    n_pre, n_dec = parse_topology(topology)
+    if model is None:
+        model = Model(tiny("llama", dtype="float32", param_dtype="float32"))
+        params = model.init(jax.random.PRNGKey(0))
+    roles = ["prefill"] * n_pre + ["decode"] * n_dec
+    if not roles:
+        raise ValueError("empty topology")
+    if n_pre == 0:  # '4' shorthand: a role-less pool
+        roles = ["both"] * n_dec
+    replicas = [start_replica(model, params, role, page_size=page_size,
+                              max_batch=max_batch, max_seq=max_seq,
+                              num_pages=num_pages, warm=warm,
+                              warm_len=warm_len)
+                for role in roles]
+    registry = MetricsRegistry()
+    pool = ReplicaPool([r.rid for r in replicas],
+                       probe_interval=probe_interval, registry=registry)
+    policy = PrefixAffinityPolicy(pool, page_size=page_size,
+                                  affinity_blocks=affinity_blocks)
+    cp_state = ControlPlaneState(pool, policy, registry=registry,
+                                 read_timeout=120.0,
+                                 disagg_threshold=disagg_threshold)
+    pool.probe_all()  # learn roles before the first request routes
+    pool.start()
+    cp_httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                   make_fleet_handler(cp_state))
+    threading.Thread(target=cp_httpd.serve_forever, daemon=True).start()
+    return FleetHandle(replicas, cp_state, cp_httpd)

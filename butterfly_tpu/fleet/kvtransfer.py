@@ -1,0 +1,163 @@
+"""Cross-replica KV page transfer: chain-hash-addressed export/import.
+
+The prefix cache (cache/prefix.py) already makes every registered KV
+page content-addressable: page i's key is a SHA-256 chain digest
+committing to all tokens of blocks 0..i. This module serializes those
+pages between replicas by that key — the replica half of the control
+plane's disaggregated prefill/decode handoff (fleet/controlplane.py):
+
+* ``export_payload(sched, hex_hashes)`` — resolve the requested chain
+  on the LOCAL registry, pin the matched leading run against eviction,
+  read the page contents to the host in one gather, unpin, and return
+  a JSON-safe payload (base64 page bytes + dtype/shape metadata +
+  geometry). Hashes past the first miss are reported ``missing`` —
+  pages behind a gap could never be attached by ``admit`` anyway.
+* ``import_payload(sched, payload)`` — validate geometry (page size,
+  layer/head/dim counts, dtype, quantization MUST match; a mismatched
+  import would alias garbage K/V under a valid-looking hash), claim
+  free pages via ``import_page`` in chain order, scatter the bytes into
+  the local pool, and leave the pages warm in the registry so the next
+  admission of the same prefix hits them like any local prefix-cache
+  entry.
+
+Correctness never depends on a transfer landing: an evicted / missing /
+partially imported chain just means the decode replica prefills the
+uncovered tail itself. Both sides run under the serving lock
+(serve/server.py handler threads), so the scheduler thread can neither
+donate the pools mid-read nor recycle a page mid-write.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Dict, List
+
+import numpy as np
+
+PAYLOAD_VERSION = 1
+
+
+def _enc(a: np.ndarray) -> Dict:
+    return {"b64": base64.b64encode(np.ascontiguousarray(a).tobytes())
+            .decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _dec(obj: Dict) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(obj["b64"]),
+                         dtype=np.dtype(obj["dtype"])
+                         ).reshape(obj["shape"])
+
+
+def _geometry(sched) -> Dict:
+    cache = sched.engine.cache
+    return {
+        "page_size": cache.page_size,
+        "num_layers": int(cache.k_pages.shape[0]),
+        "num_kv_heads": int(cache.k_pages.shape[2]),
+        "head_dim": int(cache.k_pages.shape[4]),
+        "dtype": str(np.dtype(cache.k_pages.dtype)),
+        "quantized": bool(cache.quantized),
+    }
+
+
+def _registry_alloc(sched):
+    """The scheduler's allocator, iff it carries the prefix registry the
+    transfer is keyed by (prefix_caching on)."""
+    alloc = sched.alloc
+    if not hasattr(alloc, "lookup"):
+        raise LookupError(
+            "KV transfer requires prefix caching (--prefix-caching): "
+            "without the content-hash page registry there is nothing "
+            "to address pages by")
+    return alloc
+
+
+def export_payload(sched, hex_hashes: List[str]) -> Dict:
+    """Serialize the leading registered run of `hex_hashes` from
+    `sched`'s page pool. Caller holds the serving lock."""
+    alloc = _registry_alloc(sched)
+    hashes = [bytes.fromhex(h) for h in hex_hashes]
+    matched: List[int] = []
+    for h in hashes:
+        pid = alloc.lookup(h)
+        if pid is None:
+            break
+        matched.append(pid)
+    payload: Dict = {
+        "version": PAYLOAD_VERSION,
+        "meta": _geometry(sched),
+        "pages": [],
+        "missing": hex_hashes[len(matched):],
+        "bytes": 0,
+    }
+    if not matched:
+        return payload
+    # pin the whole run before any device read: the gather below may
+    # release the GIL, and an admission on the scheduler thread (once
+    # the lock is handed back between chunked exports) must never
+    # recycle a page mid-transfer
+    alloc.pin(matched)
+    try:
+        k, v, ks, vs = sched.engine.read_pages(matched)
+    finally:
+        alloc.unpin(matched)
+    total = 0
+    for i, h in enumerate(hex_hashes[:len(matched)]):
+        entry = {"hash": h, "k": _enc(k[:, i]), "v": _enc(v[:, i])}
+        total += k[:, i].nbytes + v[:, i].nbytes
+        if ks is not None:
+            entry["k_scale"] = _enc(ks[:, i])
+            entry["v_scale"] = _enc(vs[:, i])
+            total += ks[:, i].nbytes + vs[:, i].nbytes
+        payload["pages"].append(entry)
+    payload["bytes"] = total
+    return payload
+
+
+def import_payload(sched, payload: Dict) -> Dict:
+    """Land an export_payload into `sched`'s pool + prefix registry.
+    Caller holds the serving lock. Raises ValueError on geometry
+    mismatch (nothing imported); page exhaustion mid-chain stops the
+    import with the leading run landed (reported ``no_space``)."""
+    alloc = _registry_alloc(sched)
+    if int(payload.get("version", -1)) != PAYLOAD_VERSION:
+        raise ValueError(f"unsupported KV payload version "
+                         f"{payload.get('version')!r}")
+    meta, local = payload.get("meta", {}), _geometry(sched)
+    bad = {k: (meta.get(k), local[k]) for k in local
+           if meta.get(k) != local[k]}
+    if bad:
+        raise ValueError(
+            "KV geometry mismatch (theirs vs ours): "
+            + ", ".join(f"{k}={a!r}/{b!r}" for k, (a, b) in bad.items()))
+    imported = skipped = 0
+    no_space = False
+    pids: List[int] = []
+    ks_list, vs_list = [], []
+    k_list, v_list = [], []
+    for entry in payload.get("pages", ()):
+        h = bytes.fromhex(entry["hash"])
+        try:
+            pid = alloc.import_page(h)
+        except MemoryError:
+            no_space = True
+            break  # chain order: what landed is a usable leading run
+        if pid is None:
+            skipped += 1
+            continue
+        pids.append(pid)
+        k_list.append(_dec(entry["k"]))
+        v_list.append(_dec(entry["v"]))
+        if local["quantized"]:
+            ks_list.append(_dec(entry["k_scale"]))
+            vs_list.append(_dec(entry["v_scale"]))
+        imported += 1
+    if pids:
+        # one stacked scatter: [L, n, Kv, page, H] in page order
+        k = np.stack(k_list, axis=1)
+        v = np.stack(v_list, axis=1)
+        ks = np.stack(ks_list, axis=1) if ks_list else None
+        vs = np.stack(vs_list, axis=1) if vs_list else None
+        sched.engine.write_pages(pids, k, v, ks, vs)
+    return {"imported": imported, "skipped": skipped,
+            "no_space": no_space, "free_pages": alloc.free_pages}

@@ -276,3 +276,86 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
         if k in m:
             out[k + "_tick_burst"] = m[k]
     return out
+
+
+def _loadgen():
+    """Import tools/loadgen.py (stdlib-only, lives outside the package
+    — same sys.path dance the router tests use)."""
+    import importlib
+    import sys
+    from pathlib import Path
+    tools = str(Path(__file__).resolve().parents[2] / "tools")
+    sys.path.insert(0, tools)
+    try:
+        return importlib.import_module("loadgen")
+    finally:
+        sys.path.remove(tools)
+
+
+def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
+                        requests_per_client: int = 4,
+                        prompt_len: int = 48, max_tokens: int = 8,
+                        page_size: int = 8, max_batch: int = 2,
+                        disagg_threshold: int = 16,
+                        prefix_share: float = 0.5,
+                        seed: int = 0) -> Dict:
+    """Fleet soak benchmark: an in-process disaggregated topology
+    (fleet/harness.py — tiny model always: the fleet numbers measure
+    the CONTROL PLANE, not the model) driven by the loadgen soak
+    through a full rolling drain/restart cycle.
+
+    Two phases at the same workload: a DIRECT phase (disaggregation
+    off — every request dispatches straight to the decode tier) for
+    the before-TTFT, then the disaggregated soak with rolling
+    drain/restart for the after-TTFT, the transfer counters, and the
+    zero-drop property. Emits the fleet_* keys the bench JSON carries:
+    fleet_ttft_p50/p95 (+ the direct-phase _direct twins),
+    kv_transfer_bytes, kv_transfer_hit_rate, drop counts."""
+    from butterfly_tpu.fleet.harness import start_fleet
+
+    lg = _loadgen()
+    shared_len = max(page_size * 4, disagg_threshold)
+    tail = page_size // 2
+    fleet = start_fleet(topology, page_size=page_size,
+                        max_batch=max_batch,
+                        max_seq=shared_len + tail + max_tokens + 16,
+                        disagg_threshold=disagg_threshold,
+                        # warm at the workload's prompt length so phase
+                        # 1 (the before-TTFT) doesn't eat the XLA
+                        # compile for the workload's prefill bucket
+                        warm_len=shared_len + tail)
+    try:
+        # phase 1 — direct (the "before"): threshold above any prompt
+        fleet.state.disagg_threshold = 10 ** 9
+        direct = lg.run_load(fleet.url, clients=clients,
+                             requests_per_client=requests_per_client,
+                             prefix_share=prefix_share,
+                             shared_len=shared_len, tail_len=tail,
+                             max_tokens=max_tokens, seed=seed)
+        # phase 2 — disaggregated soak with rolling drain/restart
+        fleet.state.disagg_threshold = disagg_threshold
+        soak = lg.run_fleet_soak(
+            fleet.url, clients=clients,
+            requests_per_client=requests_per_client,
+            prefix_share=prefix_share, shared_len=shared_len,
+            tail_len=tail, max_tokens=max_tokens, seed=seed + 1,
+            replicas=fleet.rids,
+            restart_hook=lambda rid: fleet.by_rid[rid].restart())
+    finally:
+        fleet.stop()
+    fm = soak.get("fleet_metrics", {})
+    return {
+        "fleet_topology": topology,
+        "fleet_requests": soak["sent"],
+        "fleet_dropped": soak["failed"],
+        "fleet_disaggregated": soak["disaggregated"],
+        "fleet_ttft_p50": soak["ttft_p50_s"],
+        "fleet_ttft_p95": soak["ttft_p95_s"],
+        "fleet_ttft_direct_p50": direct["ttft_p50_s"],
+        "fleet_ttft_direct_p95": direct["ttft_p95_s"],
+        "fleet_rps": soak["rps"],
+        "kv_transfer_bytes": fm.get("kv_transfer_bytes", 0.0),
+        "kv_transfer_pages": fm.get("kv_transfer_pages", 0.0),
+        "kv_transfer_hit_rate": fm.get("kv_transfer_hit_rate", 0.0),
+        "fleet_rolling_cycles": len(soak.get("rolling_cycles", ())),
+    }

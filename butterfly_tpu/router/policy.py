@@ -109,9 +109,13 @@ class PrefixAffinityPolicy:
         self.saturate_after = saturate_after
         self.ring = HashRing(list(pool.replicas), vnodes=vnodes)
 
-    def plan(self, tokens: Optional[List[int]]
+    def plan(self, tokens: Optional[List[int]], role: Optional[str] = None
              ) -> Tuple[List[Replica], Optional[str]]:
-        cands = self.pool.candidates()
+        """`role` restricts the candidate pool to one fleet tier
+        (pool.candidates(role)); the ring is still walked over ALL
+        replica ids, so a tier's affinity arcs stay stable when the
+        other tier's membership changes."""
+        cands = self.pool.candidates(role)
         if not cands:
             return [], None
         by_load = sorted(cands, key=Replica.load_score)

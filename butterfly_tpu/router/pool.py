@@ -49,7 +49,8 @@ class Replica:
 
     __slots__ = ("rid", "host", "port", "liveness", "drain", "outstanding",
                  "queue_depth", "active", "fails", "probes", "last_probe_t",
-                 "next_probe_t", "last_error")
+                 "next_probe_t", "last_error", "role", "free_pages",
+                 "inflight")
 
     def __init__(self, rid: str, host: str, port: int):
         self.rid = rid
@@ -63,6 +64,9 @@ class Replica:
         self.outstanding = 0     # router-tracked in-flight proxied requests
         self.queue_depth = 0     # from the last /health scrape
         self.active = 0          # from the last /health scrape
+        self.role = "both"       # fleet tier (prefill|decode|both), scraped
+        self.free_pages: Optional[int] = None  # KV page headroom, scraped
+        self.inflight = 0        # decode blocks in flight, scraped
         self.fails = 0           # consecutive probe/connect failures
         self.probes = 0
         self.last_probe_t: Optional[float] = None
@@ -78,16 +82,28 @@ class Replica:
     def routable(self) -> bool:
         return self.liveness == LIVE and not self.drain
 
+    def serves(self, role: Optional[str]) -> bool:
+        """Does this replica belong to the given fleet tier? role=None
+        means any; 'both' replicas belong to every tier."""
+        return role is None or self.role == role or self.role == "both"
+
     def load_score(self):
         """Ordering key for least-loaded fallback: router-tracked
         outstanding first (always fresh), then the replica's own scraped
-        backlog, then rid for determinism."""
-        return (self.outstanding, self.queue_depth + self.active, self.rid)
+        backlog, then KV page PRESSURE (negated free-page headroom: a
+        replica one admission from page exhaustion — and therefore from
+        preempting its own runners — must stop winning least-outstanding
+        ties; unknown headroom scores as zero pages, the conservative
+        read for a member that has never answered a probe), then rid for
+        determinism."""
+        return (self.outstanding, self.queue_depth + self.active,
+                -(self.free_pages or 0), self.rid)
 
     def snapshot(self) -> dict:
-        return {"replica": self.rid, "state": self.state,
+        return {"replica": self.rid, "state": self.state, "role": self.role,
                 "outstanding": self.outstanding,
                 "queue_depth": self.queue_depth, "active": self.active,
+                "free_pages": self.free_pages, "inflight": self.inflight,
                 "consecutive_failures": self.fails,
                 "probes": self.probes, "last_error": self.last_error}
 
@@ -143,18 +159,24 @@ class ReplicaPool:
         with self._lock:
             return [r for r in self.replicas.values() if r.routable]
 
-    def candidates(self) -> List[Replica]:
+    def candidates(self, role: Optional[str] = None) -> List[Replica]:
         """Replicas worth attempting, best liveness first: routable ones,
         else (all degraded — e.g. one connect blip marked the only
         replica before its re-probe) the degraded ones as a last resort.
         Dead and draining members are never returned — dead is the
-        pool's signal the proxy must not waste a connect on it."""
+        pool's signal the proxy must not waste a connect on it.
+        `role` restricts to one fleet tier ('prefill'/'decode'; 'both'
+        replicas belong to every tier) — the control plane's
+        disaggregated planner asks per tier, the plain router asks for
+        all."""
         with self._lock:
-            live = [r for r in self.replicas.values() if r.routable]
+            live = [r for r in self.replicas.values()
+                    if r.routable and r.serves(role)]
             if live:
                 return live
             return [r for r in self.replicas.values()
-                    if r.liveness == DEGRADED and not r.drain]
+                    if r.liveness == DEGRADED and not r.drain
+                    and r.serves(role)]
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -229,6 +251,12 @@ class ReplicaPool:
                 r.last_error = ""
                 r.queue_depth = int(detail.get("queue_depth", 0) or 0)
                 r.active = int(detail.get("active", 0) or 0)
+                # fleet signals (serve/server.py /health): absent on a
+                # pre-fleet replica — keep the conservative defaults
+                r.role = str(detail.get("role") or "both")
+                fp = detail.get("free_pages")
+                r.free_pages = int(fp) if fp is not None else None
+                r.inflight = int(detail.get("inflight_depth", 0) or 0)
                 r.next_probe_t = now + self.probe_interval
             elif ok is False:  # wedged: degraded, normal re-probe cadence
                 r.liveness = DEGRADED

@@ -213,8 +213,16 @@ def make_router_handler(state: RouterState):
             except (ValueError, OSError):
                 self._json(400, {"error": "unreadable body"})
                 return
-            candidates, affinity_rid = state.policy.plan(
-                extract_route_tokens(body))
+            self._dispatch(path, body)
+
+        def _dispatch(self, path: str, body: bytes,
+                      candidates=None, affinity_rid=None) -> None:
+            """Plan (unless the caller — e.g. the fleet control plane's
+            classifier — already planned) and walk the candidate list
+            with the single failover rule."""
+            if candidates is None:
+                candidates, affinity_rid = state.policy.plan(
+                    extract_route_tokens(body))
             if not candidates:
                 state.inc(state._c_unroutable)
                 self._json(503, {"error": "no live replicas"},

@@ -94,6 +94,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "then reports enabled=false); tracing is on by "
                         "default and costs one ring-buffer append per "
                         "scheduling event")
+    s.add_argument("--role", choices=["prefill", "decode", "both"],
+                   default="both",
+                   help="fleet placement role advertised on /health: the "
+                        "disaggregated control plane (`butterfly route "
+                        "--disaggregate`) sends prefill-heavy requests to "
+                        "'prefill' replicas and generation to 'decode' "
+                        "ones. Advisory — the replica serves whatever it "
+                        "is sent; 'both' (default) joins both tiers")
     s.add_argument("--prefix-caching", action="store_true",
                    help="reuse KV pages across requests sharing a prompt "
                         "prefix (content-hashed, refcounted; cuts TTFT for "
@@ -188,6 +196,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "exponential backoff)")
     r.add_argument("--read-timeout", type=float, default=300.0,
                    help="per-request socket timeout toward a replica")
+    r.add_argument("--disaggregate", action="store_true",
+                   help="run the KV-aware fleet control plane instead of "
+                        "the plain router: prefill-heavy requests go to "
+                        "--role prefill replicas, their KV pages stream "
+                        "to a --role decode replica by chain hash "
+                        "(GET /kv/pages -> POST /kv/import), and "
+                        "generation finishes there; GET /fleet/state "
+                        "exposes the placement table")
+    r.add_argument("--disagg-threshold", type=int, default=64,
+                   help="predicted fresh-prefill tokens at which a "
+                        "request is worth the prefill/decode handoff "
+                        "(below it, requests dispatch directly to the "
+                        "decode tier)")
+
+    # local disaggregated fleet for manual debugging: N prefill + M
+    # decode in-process replicas behind one control plane, all tiny-
+    # model loopback — the same harness the fleet soak tests drive.
+    f = sub.add_parser("fleet",
+                       help="spin a local prefill/decode fleet (replicas "
+                            "+ control plane, in-process) for manual "
+                            "debugging")
+    f.add_argument("--topology", default="2p2d",
+                   help="'<N>p<M>d' = N prefill + M decode replicas "
+                        "(default 2p2d), or a bare count for a "
+                        "role-less pool")
+    f.add_argument("--page-size", type=int, default=8)
+    f.add_argument("--max-batch", type=int, default=2)
+    f.add_argument("--max-seq", type=int, default=128)
+    f.add_argument("--disagg-threshold", type=int, default=16)
     return p
 
 
@@ -387,8 +424,18 @@ def cmd_bench(args) -> int:
 
 
 def cmd_route(args) -> int:
-    from butterfly_tpu.router.proxy import route_forever
     backends = [b for b in args.backends.split(",") if b.strip()]
+    if args.disaggregate:
+        from butterfly_tpu.fleet.controlplane import fleet_forever
+        return fleet_forever(backends, host=args.host, port=args.port,
+                             page_size=args.page_size,
+                             affinity_blocks=args.affinity_blocks,
+                             saturate_after=args.saturate_after,
+                             probe_interval=args.probe_interval,
+                             dead_after=args.dead_after,
+                             read_timeout=args.read_timeout,
+                             disagg_threshold=args.disagg_threshold)
+    from butterfly_tpu.router.proxy import route_forever
     return route_forever(backends, host=args.host, port=args.port,
                          page_size=args.page_size,
                          affinity_blocks=args.affinity_blocks,
@@ -398,10 +445,36 @@ def cmd_route(args) -> int:
                          read_timeout=args.read_timeout)
 
 
+def cmd_fleet(args) -> int:
+    """`butterfly fleet`: the in-process soak topology, held open for
+    manual poking (curl the printed control-plane URL)."""
+    from butterfly_tpu.fleet.harness import start_fleet
+
+    print(f"[butterfly] starting local fleet {args.topology} "
+          f"(tiny model, warming each replica)...", flush=True)
+    fleet = start_fleet(args.topology, page_size=args.page_size,
+                        max_batch=args.max_batch, max_seq=args.max_seq,
+                        disagg_threshold=args.disagg_threshold)
+    print(f"[butterfly] control plane: {fleet.url}  "
+          f"(GET /fleet/state, POST /generate)", flush=True)
+    for r in fleet.replicas:
+        print(f"[butterfly]   replica {r.rid}  role={r.role}", flush=True)
+    print("[butterfly] Ctrl-C to stop", flush=True)
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fleet.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"generate": cmd_generate, "serve": cmd_serve,
-            "bench": cmd_bench, "route": cmd_route}[args.cmd](args)
+            "bench": cmd_bench, "route": cmd_route,
+            "fleet": cmd_fleet}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -17,10 +17,19 @@ zero-egress environment):
   "finish_reason"}], "usage"}; streaming sends OpenAI-style SSE chunks.
 * GET /metrics    Prometheus text (obs/metrics.py + the typed registry's
   histogram series — obs/registry.py)
-* GET /health     {"status": "ok", "queue_depth": N, "active": M} — one
-  cheap JSON probe carrying the load signal the multi-replica router's
-  least-loaded policy reads (no Prometheus text scrape needed); 503
-  with a detail string when wedged.
+* GET /health     {"status": "ok", "role", "queue_depth", "active",
+  "free_pages", "inflight_depth"} — one cheap JSON probe carrying every
+  load/placement signal the router AND the fleet control plane read
+  (queue depth + page headroom + pipeline depth + replica role; no
+  Prometheus text scrape, no second poll path); 503 with a detail
+  string when wedged.
+* GET /kv/pages?hashes=h1,h2,...   export registered prefix-cache KV
+  pages by chain hash (fleet/kvtransfer.py payload: base64 page bytes +
+  geometry; the leading registered run ships, the rest come back
+  "missing"). Requires --prefix-caching (501 otherwise).
+* POST /kv/import   land an exported payload into the local pool +
+  prefix registry as warm pages (the decode half of the disaggregated
+  prefill/decode handoff); 409 on KV geometry mismatch.
 * GET /debug/requests[?n=K]   recent per-request trace timelines as JSON
   (obs/trace.py; requires the scheduler to be built with a Tracer —
   returns {"enabled": false} otherwise). Clients may tag requests with
@@ -104,10 +113,19 @@ class StopSequenceMatcher:
 
 class ServerState:
     def __init__(self, scheduler, tokenizer, max_queue: int = 256,
-                 heartbeat=None, model_name: str = "butterfly"):
+                 heartbeat=None, model_name: str = "butterfly",
+                 role: str = "both"):
         self.sched = scheduler
         self.tok = tokenizer
         self.model_name = model_name  # echoed by /v1/completions
+        # fleet placement advertisement (prefill | decode | both):
+        # carried on /health so the control plane learns the tier from
+        # the same probe the router pool already runs. Advisory only —
+        # a prefill replica still decodes if asked (the control plane
+        # just stops sending decodes there).
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown replica role {role!r}")
+        self.role = role
         self.lock = threading.Lock()       # guards scheduler state
         self.wake = threading.Event()      # new work signal
         self.stop = threading.Event()
@@ -229,6 +247,27 @@ class ServerState:
                                  registry=getattr(self.sched, "registry",
                                                   None))
 
+    def export_kv(self, hex_hashes) -> dict:
+        """GET /kv/pages body: export registered pages by chain hash.
+        Under the serving lock — the scheduler thread must not donate
+        the pools (every decode/prefill dispatch donates them) while
+        the export gather reads page bytes out."""
+        from butterfly_tpu.fleet.kvtransfer import export_payload
+        with self.lock:
+            if self.error:
+                raise RuntimeError("server wedged: " + self.error)
+            return export_payload(self.sched, hex_hashes)
+
+    def import_kv(self, payload: dict) -> dict:
+        """POST /kv/import body -> result. Under the serving lock: the
+        import claims pages from the same free/evictable lists
+        admissions allocate from."""
+        from butterfly_tpu.fleet.kvtransfer import import_payload
+        with self.lock:
+            if self.error:
+                raise RuntimeError("server wedged: " + self.error)
+            return import_payload(self.sched, payload)
+
     def debug_requests(self, n: Optional[int] = None) -> dict:
         """Recent per-request trace timelines (the /debug/requests
         body). Reads only the tracer's own lock — a wedged scheduler
@@ -275,18 +314,28 @@ def make_handler(state: ServerState):
                     self._json(503, {"status": "error",
                                      "detail": state.error})
                 else:
-                    # queue_depth/active are deliberately read WITHOUT
-                    # state.lock: len() on the scheduler's deque/list is
-                    # atomic enough for a load probe (one update stale at
+                    # every field is deliberately read WITHOUT
+                    # state.lock: len() on the scheduler's deque/list
+                    # and the allocator's free-list length are atomic
+                    # enough for a load probe (one update stale at
                     # worst), and /health must stay responsive even when
                     # a slow tick holds the lock — the router's prober
-                    # times out a hanging probe into "degraded".
+                    # times out a hanging probe into "degraded". One
+                    # probe carries the full control-plane signal set
+                    # (role, page headroom, pipeline depth): the fleet
+                    # tier needs no second poll path.
                     body = {"status": "ok",
+                            "role": state.role,
                             "queue_depth": len(state.sched.waiting),
-                            "active": len(state.sched._all_live)}
+                            "active": len(state.sched._all_live),
+                            "free_pages": state.sched.alloc.free_pages,
+                            "inflight_depth":
+                                len(state.sched._inflight)}
                     if state.heartbeat is not None:
                         body["heartbeats"] = state.heartbeat.beats
                     self._json(200, body)
+            elif self.path.split("?")[0] == "/kv/pages":
+                self._handle_kv_export()
             elif self.path == "/metrics":
                 body = state.metrics_text().encode()
                 self.send_response(200)
@@ -319,8 +368,50 @@ def make_handler(state: ServerState):
                 self._handle_generate()
             elif self.path == "/v1/completions":
                 self._handle_completions()
+            elif self.path == "/kv/import":
+                self._handle_kv_import()
             else:
                 self._json(404, {"error": "not found"})
+
+        def _handle_kv_export(self):
+            from urllib.parse import parse_qs, urlparse
+            try:
+                qs = parse_qs(urlparse(self.path).query)
+                hashes = [h for h in
+                          ",".join(qs.get("hashes", [])).split(",") if h]
+                for h in hashes:  # validate before touching the lock
+                    bytes.fromhex(h)
+            except (ValueError, TypeError):
+                self._json(400, {"error": "hashes must be comma-separated "
+                                          "hex chain digests"})
+                return
+            if not hashes:
+                self._json(400, {"error": "missing ?hashes= query"})
+                return
+            try:
+                self._json(200, state.export_kv(hashes))
+            except LookupError as e:  # no prefix registry on this replica
+                self._json(501, {"error": str(e)})
+            except RuntimeError as e:  # wedged
+                self._json(503, {"error": str(e)})
+
+        def _handle_kv_import(self):
+            try:
+                payload = self._read_body()
+            except (ValueError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                self._json(200, state.import_kv(payload))
+            except LookupError as e:
+                self._json(501, {"error": str(e)})
+            except (ValueError, KeyError, TypeError) as e:
+                # geometry mismatch / malformed page entries: refusing
+                # is the safety property — a mismatched import would
+                # alias garbage K/V under a valid-looking chain hash
+                self._json(409, {"error": f"{e}"})
+            except RuntimeError as e:  # wedged
+                self._json(503, {"error": str(e)})
 
         def _read_body(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))
@@ -475,6 +566,12 @@ def make_handler(state: ServerState):
                 "text": state.tok.decode(toks),
                 "ttft_s": req.ttft,
                 "total_s": time.monotonic() - t0,
+                # stop-token finish vs budget finish: the disaggregated
+                # control plane's prefill leg (max_tokens=1) reads this
+                # to know whether generation already ended — it cannot
+                # infer the replica's default EOS id itself
+                "stopped": bool(req.stop_token >= 0 and toks
+                                and toks[-1] == req.stop_token),
             })
 
         def _handle_completions(self):
@@ -670,7 +767,8 @@ def make_handler(state: ServerState):
 def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
                   port: int = 8000, max_queue: int = 256,
                   ready_event: Optional[threading.Event] = None,
-                  heartbeat=None, model_name: str = "butterfly"):
+                  heartbeat=None, model_name: str = "butterfly",
+                  role: str = "both"):
     """Blocking serve loop. `ready_event` is set once listening (tests).
 
     `heartbeat`: a HeartbeatMonitor to use (callers may tune interval /
@@ -685,7 +783,8 @@ def serve_forever(scheduler, tokenizer, host: str = "0.0.0.0",
     if heartbeat is None:
         heartbeat = HeartbeatMonitor()
     state = ServerState(scheduler, tokenizer, max_queue,
-                        heartbeat=heartbeat, model_name=model_name)
+                        heartbeat=heartbeat, model_name=model_name,
+                        role=role)
     state.thread.start()
     # stdlib default listen backlog is 5: a burst of concurrent clients
     # gets connection resets before the accept loop ever sees them
@@ -764,4 +863,5 @@ def run_server(args) -> int:
           f"(slots={rt.max_batch_size}, pages={engine.cache.num_pages - 1}"
           f"x{rt.page_size}tok{mesh_desc})", flush=True)
     return serve_forever(sched, tok, args.host, args.port,
-                         max_queue=rt.max_queue, model_name=args.model)
+                         max_queue=rt.max_queue, model_name=args.model,
+                         role=getattr(args, "role", "both"))

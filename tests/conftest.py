@@ -111,6 +111,24 @@ SLOW_TESTS = {
     "test_pipelined_greedy_parity_vs_synchronous",
     "test_pipelined_greedy_parity_fused_k8",
     "test_pipelined_parity_under_page_pressure",
+    # fleet scenarios that compile one-or-more extra engines or spin a
+    # multi-replica in-process topology (the fast tier keeps the pure-
+    # host fleet units: allocator transfer surface, load_score page
+    # pressure, role-filtered candidates, topology parsing)
+    "test_export_import_roundtrip_and_warm_hit",
+    "test_export_reports_missing_tail",
+    "test_import_refuses_geometry_mismatch",
+    "test_import_idempotent",
+    "test_health_carries_fleet_signals",
+    "test_kv_endpoint_roundtrip_over_http",
+    "test_kv_export_bad_requests",
+    "test_kv_import_mismatch_is_409",
+    "test_fleet_state_table",
+    "test_disaggregated_parity_with_single_replica",
+    "test_short_prompt_routes_direct",
+    "test_string_prompt_routes_direct",
+    "test_handoff_falls_back_when_prefill_tier_dies",
+    "test_fleet_soak_rolling_drain_restart",
 }
 
 

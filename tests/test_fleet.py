@@ -1,0 +1,429 @@
+"""Fleet control plane (ISSUE 6): disaggregated prefill/decode with
+cross-replica KV page transfer.
+
+Layered like the subsystem:
+
+* allocator units — the transfer surface on PrefixCachingAllocator
+  (lookup / pin / unpin / import_page) with the full-accounting
+  invariant checked after every mutation;
+* kvtransfer units — export/import payload roundtrip between two real
+  schedulers, geometry refusal, missing-hash reporting;
+* HTTP endpoints — /kv/pages, /kv/import, the enriched /health;
+* the control plane — classification, the disaggregated handoff with
+  BYTE-IDENTICAL greedy parity vs single-replica serving (the
+  acceptance contract), fallback when a tier dies mid-handoff;
+* the fleet soak — 2 prefill + 2 decode replicas through a rolling
+  drain/restart cycle with zero dropped un-started requests and a
+  positive transfer hit rate.
+
+Everything runs in-process on the tiny model (the test_router.py
+idiom); the multi-replica pieces are slow-marked in conftest.py.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from butterfly_tpu.cache.prefix import (
+    PrefixCachingAllocator, chain_block_hashes)
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.engine.serving import ServingEngine
+from butterfly_tpu.fleet.kvtransfer import export_payload, import_payload
+from butterfly_tpu.models.common import Model
+from butterfly_tpu.sched.scheduler import Scheduler
+
+CFG = tiny("llama", dtype="float32", param_dtype="float32")
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def shared_model():
+    model = Model(CFG)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def make_sched(shared_model, max_batch=2, max_seq=128, num_pages=None):
+    model, params = shared_model
+    rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
+                       page_size=PAGE, num_pages=num_pages,
+                       prefix_caching=True)
+    return Scheduler(ServingEngine(model, params, rt))
+
+
+def post(url, path, obj, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# allocator units: the transfer surface
+# ---------------------------------------------------------------------------
+
+def test_lookup_and_import_page():
+    a = PrefixCachingAllocator(num_pages=8, page_size=4, max_pages_per_seq=8)
+    seq = list(range(9))  # 2 full pages
+    a.admit(0, seq, len(seq) + 1)
+    a.register(0, seq)
+    h1, h2 = chain_block_hashes(seq, 4)
+    assert a.lookup(h1) == a.pages_of(0)[0]
+    assert a.lookup(h2) == a.pages_of(0)[1]
+    assert a.lookup(b"\x00" * 32) is None
+    # import of an already-registered digest is a no-op (idempotent)
+    assert a.import_page(h1) is None
+    # a fresh digest claims a page and registers it warm (evictable)
+    h3 = chain_block_hashes(seq[:4] + [99] * 4, 4)[-1]
+    pid = a.import_page(h3)
+    assert pid is not None and a.lookup(h3) == pid
+    assert pid in a._evictable
+    a.check_invariants()
+    a.release(0)
+    a.check_invariants()
+
+
+def test_imported_pages_attach_like_local_hits():
+    """A chain imported (not computed locally) must satisfy a later
+    admit exactly like a locally registered prefix."""
+    a = PrefixCachingAllocator(num_pages=8, page_size=4, max_pages_per_seq=8)
+    seq = list(range(10))  # 2 full pages + tail
+    for h in chain_block_hashes(seq, 4):
+        assert a.import_page(h) is not None
+    a.check_invariants()
+    assert a.admit(0, seq, len(seq) + 1) == 8  # both pages hit
+    a.check_invariants()
+
+
+def test_pin_blocks_eviction():
+    """A pinned warm page must survive allocation pressure that would
+    otherwise evict it (the export-in-progress guarantee)."""
+    a = PrefixCachingAllocator(num_pages=2, page_size=4, max_pages_per_seq=2)
+    seq = list(range(5))  # 1 full page
+    a.admit(0, seq, len(seq) + 1)     # 2 pages: 1 registered + 1 private
+    a.register(0, seq)
+    (h,) = chain_block_hashes(seq, 4)
+    pid = a.lookup(h)
+    a.release(0)                       # registered page goes warm
+    a.pin([pid])
+    # both raw-free pages get consumed; the pinned page must NOT be
+    # recycled even though the free list runs dry
+    assert a.grow(1, 4) is not None
+    assert a.grow(1, 8) is None        # only the pinned page "left"
+    assert a.lookup(h) == pid          # still registered
+    a.unpin([pid])
+    assert a.grow(1, 8) is not None    # now evictable again
+    assert a.lookup(h) is None         # eviction deregistered it
+    a.check_invariants()
+
+
+def test_import_page_exhaustion():
+    a = PrefixCachingAllocator(num_pages=1, page_size=4, max_pages_per_seq=4)
+    a.grow(0, 4)  # the only page is slot-held: not free, not evictable
+    with pytest.raises(MemoryError):
+        a.import_page(b"\x01" * 32)
+    a.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# kvtransfer payloads between two real schedulers
+# ---------------------------------------------------------------------------
+
+def test_export_import_roundtrip_and_warm_hit(shared_model):
+    """Pages exported from A and imported into B give B's admission a
+    full prefix hit, and the decoded continuation is byte-identical to
+    a single-replica run — K/V bytes moved, semantics did not."""
+    prompt = list(range(1, 41))  # 5 full pages
+    a = make_sched(shared_model)
+    ra = a.submit(prompt, max_new_tokens=1, stop_token=-1)
+    a.run_until_done()
+    hashes = [h.hex() for h in chain_block_hashes(prompt, PAGE)]
+    payload = export_payload(a, hashes)
+    assert [p["hash"] for p in payload["pages"]] == hashes
+    assert payload["missing"] == []
+    assert payload["bytes"] > 0
+
+    b = make_sched(shared_model)
+    res = import_payload(b, payload)
+    assert res["imported"] == len(hashes) and not res["no_space"]
+    # B continues from A's first token with a full-prefix cache hit
+    rb = b.submit(prompt + ra.output, max_new_tokens=7, stop_token=-1)
+    b.run_until_done()
+    assert b.alloc.hit_tokens == 40  # every full page came from import
+
+    ref = make_sched(shared_model)
+    rr = ref.submit(prompt, max_new_tokens=8, stop_token=-1)
+    ref.run_until_done()
+    assert ra.output + rb.output == rr.output
+
+
+def test_export_reports_missing_tail(shared_model):
+    a = make_sched(shared_model)
+    prompt = list(range(1, 25))  # 3 full pages
+    a.submit(prompt, max_new_tokens=1, stop_token=-1)
+    a.run_until_done()
+    other = chain_block_hashes(list(range(50, 90)), PAGE)
+    hashes = [h.hex() for h in chain_block_hashes(prompt, PAGE)] \
+        + [other[-1].hex()]
+    payload = export_payload(a, hashes)
+    assert len(payload["pages"]) == 3
+    assert payload["missing"] == [other[-1].hex()]
+    # a chain that misses at block 0 ships nothing (pages behind a gap
+    # are unusable by admit)
+    cold = export_payload(a, [other[0].hex()] + hashes)
+    assert cold["pages"] == [] and len(cold["missing"]) == 5
+
+
+def test_import_refuses_geometry_mismatch(shared_model):
+    a = make_sched(shared_model)
+    prompt = list(range(1, 17))
+    a.submit(prompt, max_new_tokens=1, stop_token=-1)
+    a.run_until_done()
+    payload = export_payload(
+        a, [h.hex() for h in chain_block_hashes(prompt, PAGE)])
+    bad = dict(payload)
+    bad["meta"] = {**payload["meta"], "page_size": 16}
+    b = make_sched(shared_model)
+    with pytest.raises(ValueError, match="geometry"):
+        import_payload(b, bad)
+    with pytest.raises(ValueError, match="version"):
+        import_payload(b, {**payload, "version": 99})
+    # nothing landed
+    assert import_payload(b, payload)["imported"] == 2
+
+
+def test_import_idempotent(shared_model):
+    a = make_sched(shared_model)
+    prompt = list(range(1, 17))
+    a.submit(prompt, max_new_tokens=1, stop_token=-1)
+    a.run_until_done()
+    payload = export_payload(
+        a, [h.hex() for h in chain_block_hashes(prompt, PAGE)])
+    b = make_sched(shared_model)
+    assert import_payload(b, payload)["imported"] == 2
+    again = import_payload(b, payload)
+    assert again["imported"] == 0 and again["skipped"] == 2
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /health fields, /kv endpoints, /fleet/state
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_1p1d(shared_model):
+    from butterfly_tpu.fleet.harness import start_fleet
+    model, params = shared_model
+    fleet = start_fleet("1p1d", page_size=PAGE, max_batch=2, max_seq=128,
+                        disagg_threshold=16, model=model, params=params)
+    yield fleet
+    fleet.stop()
+
+
+def test_health_carries_fleet_signals(fleet_1p1d):
+    pre = fleet_1p1d.replicas[0]
+    body = get(pre.url, "/health")
+    assert body["role"] == "prefill"
+    assert body["free_pages"] > 0
+    assert body["inflight_depth"] == 0
+    assert "queue_depth" in body and "active" in body
+
+
+def test_kv_endpoint_roundtrip_over_http(fleet_1p1d):
+    pre, dec = fleet_1p1d.replicas
+    prompt = list(range(1, 25))
+    post(pre.url, "/generate", {"tokens": prompt, "max_tokens": 1,
+                                "stop_token": -1})
+    hashes = ",".join(h.hex() for h in chain_block_hashes(prompt, PAGE))
+    payload = get(pre.url, f"/kv/pages?hashes={hashes}")
+    assert len(payload["pages"]) == 3 and payload["bytes"] > 0
+    res = post(dec.url, "/kv/import", payload)
+    assert res["imported"] + res["skipped"] == 3
+
+
+def test_kv_export_bad_requests(fleet_1p1d):
+    pre = fleet_1p1d.replicas[0]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(pre.url, "/kv/pages")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(pre.url, "/kv/pages?hashes=nothex")
+    assert e.value.code == 400
+
+
+def test_kv_import_mismatch_is_409(fleet_1p1d):
+    pre, dec = fleet_1p1d.replicas
+    prompt = list(range(1, 17))
+    post(pre.url, "/generate", {"tokens": prompt, "max_tokens": 1,
+                                "stop_token": -1})
+    hashes = ",".join(h.hex() for h in chain_block_hashes(prompt, PAGE))
+    payload = get(pre.url, f"/kv/pages?hashes={hashes}")
+    payload["meta"]["num_layers"] += 1
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(dec.url, "/kv/import", payload)
+    assert e.value.code == 409
+
+
+def test_fleet_state_table(fleet_1p1d):
+    state = get(fleet_1p1d.url, "/fleet/state")
+    assert len(state["replicas"]) == 2
+    pre, dec = fleet_1p1d.replicas
+    assert state["tiers"]["prefill"] == [pre.rid]
+    assert state["tiers"]["decode"] == [dec.rid]
+    by_rid = {s["replica"]: s for s in state["replicas"]}
+    assert by_rid[pre.rid]["role"] == "prefill"
+    assert by_rid[pre.rid]["free_pages"] is not None
+    assert "kv_transfer_hit_rate" in state["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# the disaggregated handoff (acceptance: byte-identical greedy parity)
+# ---------------------------------------------------------------------------
+
+def test_disaggregated_parity_with_single_replica(fleet_1p1d, shared_model):
+    """A request prefilled on replica A and decoded on replica B
+    produces byte-identical greedy tokens to single-replica serving,
+    with the KV pages actually transferred (B prefix-hits every full
+    prompt page instead of recomputing)."""
+    pre, dec = fleet_1p1d.replicas
+    prompt = list(range(3, 43))  # 5 full pages
+    hits_before = dec.sched.alloc.hit_tokens
+    r = post(fleet_1p1d.url, "/generate",
+             {"tokens": prompt, "max_tokens": 8, "stop_token": -1})
+    assert r["disaggregated"] is True
+    assert r["prefill_replica"] == pre.rid
+    assert r["decode_replica"] == dec.rid
+    assert r["kv_pages_imported"] == 5
+    assert r["ttft_s"] > 0
+    assert dec.sched.alloc.hit_tokens - hits_before == 40
+
+    ref = make_sched(shared_model)
+    rr = ref.submit(prompt, max_new_tokens=8, stop_token=-1)
+    ref.run_until_done()
+    assert r["tokens"] == rr.output
+
+
+def test_short_prompt_routes_direct(fleet_1p1d):
+    before = fleet_1p1d.state.fleet_counters()["direct_requests"]
+    r = post(fleet_1p1d.url, "/generate",
+             {"tokens": [5, 6, 7], "max_tokens": 2, "stop_token": -1})
+    assert "disaggregated" not in r
+    after = fleet_1p1d.state.fleet_counters()["direct_requests"]
+    assert after == before + 1
+
+
+def test_string_prompt_routes_direct(fleet_1p1d):
+    """String prompts cannot be chain-hashed by the control plane (no
+    tokenizer there) — they must dispatch direct, never disaggregate."""
+    r = post(fleet_1p1d.url, "/generate",
+             {"prompt": "x" * 64, "max_tokens": 2})
+    assert "disaggregated" not in r and len(r["tokens"]) == 2
+
+
+def test_handoff_falls_back_when_prefill_tier_dies(shared_model):
+    """Prefill replica dies before the handoff: the control plane falls
+    back to a direct dispatch on the decode tier — correct tokens, no
+    client-visible failure (the failure-matrix row docs/fleet.md
+    documents)."""
+    from butterfly_tpu.fleet.harness import start_fleet
+    model, params = shared_model
+    fleet = start_fleet("1p1d", page_size=PAGE, max_batch=2, max_seq=128,
+                        disagg_threshold=16, model=model, params=params)
+    try:
+        # freeze the prober: the pool must still believe the prefill
+        # replica is live, so the request takes the HANDOFF path and
+        # exercises the mid-flight fallback (not the planner's
+        # dead-replica exclusion)
+        fleet.state.pool.stop()
+        pre = fleet.replicas[0]
+        pre.httpd.shutdown()
+        pre.httpd.server_close()
+        prompt = list(range(7, 47))
+        r = post(fleet.url, "/generate",
+                 {"tokens": prompt, "max_tokens": 4, "stop_token": -1})
+        assert "disaggregated" not in r and len(r["tokens"]) == 4
+        assert fleet.state.fleet_counters()["disagg_fallbacks"] >= 1
+        ref = make_sched(shared_model)
+        rr = ref.submit(prompt, max_new_tokens=4, stop_token=-1)
+        ref.run_until_done()
+        assert r["tokens"] == rr.output
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# load_score page pressure (satellite) — policy-level ordering
+# ---------------------------------------------------------------------------
+
+def test_load_score_prefers_page_headroom():
+    from butterfly_tpu.router.pool import Replica
+    rich = Replica("a:1", "a", 1)
+    poor = Replica("b:1", "b", 1)
+    rich.free_pages, poor.free_pages = 50, 2
+    # equal outstanding/backlog: page headroom breaks the tie
+    assert sorted([poor, rich], key=Replica.load_score)[0] is rich
+    # outstanding still dominates (freshest signal)
+    poor.outstanding, rich.outstanding = 0, 1
+    assert sorted([poor, rich], key=Replica.load_score)[0] is poor
+    # unknown headroom scores as zero pages (conservative)
+    unknown = Replica("c:1", "c", 1)
+    unknown.outstanding = 0
+    assert sorted([poor, unknown], key=Replica.load_score)[0] is poor
+
+
+def test_pool_candidates_filter_by_role():
+    from butterfly_tpu.router.pool import ReplicaPool
+    pool = ReplicaPool(["h:1", "h:2", "h:3"])
+    pool.replicas["h:1"].role = "prefill"
+    pool.replicas["h:2"].role = "decode"
+    pool.replicas["h:3"].role = "both"
+    assert {r.rid for r in pool.candidates("prefill")} == {"h:1", "h:3"}
+    assert {r.rid for r in pool.candidates("decode")} == {"h:2", "h:3"}
+    assert len(pool.candidates()) == 3
+
+
+# ---------------------------------------------------------------------------
+# the fleet soak: rolling drain/restart over 2 prefill + 2 decode
+# ---------------------------------------------------------------------------
+
+def test_fleet_soak_rolling_drain_restart(shared_model):
+    """The acceptance soak: closed-loop load over a 2p2d topology while
+    every replica is rolled through drain -> HTTP restart -> undrain.
+    Zero dropped un-started requests, transfers actually happened."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+    try:
+        from loadgen import run_fleet_soak
+    finally:
+        sys.path.pop(0)
+    from butterfly_tpu.fleet.harness import start_fleet
+    model, params = shared_model
+    fleet = start_fleet("2p2d", page_size=PAGE, max_batch=2, max_seq=128,
+                        disagg_threshold=16, model=model, params=params)
+    try:
+        stats = run_fleet_soak(
+            fleet.url, clients=3, requests_per_client=3,
+            prefix_share=0.5, shared_len=4 * PAGE, tail_len=4,
+            max_tokens=4, replicas=fleet.rids,
+            restart_hook=lambda rid: fleet.by_rid[rid].restart())
+        assert stats["failed"] == 0, stats["errors"]
+        assert stats["ok"] == 9
+        assert len(stats["rolling_cycles"]) == 4
+        assert all(c["drained"] and c["restarted"]
+                   for c in stats["rolling_cycles"])
+        fm = stats["fleet_metrics"]
+        assert fm["kv_transfer_hit_rate"] > 0
+        assert fm["kv_transfer_bytes"] > 0
+        assert stats["disaggregated"] > 0
+        # every replica answers again after its restart
+        for r in fleet.replicas:
+            assert get(r.url, "/health")["status"] == "ok"
+    finally:
+        fleet.stop()

@@ -9,7 +9,8 @@ invariant after every mutation.
 import numpy as np
 import pytest
 
-from butterfly_tpu.cache.prefix import PrefixCachingAllocator
+from butterfly_tpu.cache.prefix import (
+    PrefixCachingAllocator, chain_block_hashes)
 from butterfly_tpu.core.config import RuntimeConfig, tiny
 from butterfly_tpu.engine.serving import ServingEngine
 from butterfly_tpu.models.common import Model
@@ -25,6 +26,58 @@ PS = 4  # page size for allocator tests
 
 def toks(*vals):
     return list(vals)
+
+
+def test_chain_hash_edge_cases():
+    """The shapes the cross-replica transfer path feeds the hasher:
+    empty prompt, sub-page prompt, partial trailing page — only FULL
+    pages ever get a digest (a partial page is never registered, never
+    exported, never imported)."""
+    assert chain_block_hashes([], PS) == []
+    assert chain_block_hashes(list(range(PS - 1)), PS) == []
+    # partial trailing page contributes nothing; the full-page digests
+    # are unchanged by whatever follows them
+    full = chain_block_hashes(list(range(2 * PS)), PS)
+    ragged = chain_block_hashes(list(range(2 * PS + 3)), PS)
+    assert len(full) == 2 and ragged == full
+    # max_pages truncates, never alters, the chain
+    assert chain_block_hashes(list(range(3 * PS)), PS, max_pages=2) == full
+
+
+def test_chain_hash_stability_and_prefix_commitment():
+    """Digest i commits to ALL tokens of blocks 0..i: equal digests
+    imply equal prefixes, an early divergence changes every later
+    digest, and deterministic across calls (the property that lets two
+    replicas address each other's pages without comparing tokens)."""
+    seq = list(range(4 * PS))
+    a = chain_block_hashes(seq, PS)
+    assert a == chain_block_hashes(list(seq), PS)  # deterministic
+    # chain, not per-block: IDENTICAL blocks at different depths get
+    # different digests (position in the chain is part of the key)
+    rep = chain_block_hashes([7] * (4 * PS), PS)
+    assert len(set(rep)) == len(rep)
+    # divergence in block 0 changes EVERY digest downstream
+    b = chain_block_hashes([99] + seq[1:], PS)
+    assert all(x != y for x, y in zip(a, b))
+    # divergence in the last block leaves the shared head intact
+    c = chain_block_hashes(seq[:-1] + [99], PS)
+    assert c[:-1] == a[:-1] and c[-1] != a[-1]
+
+
+def test_chain_hash_page_size_is_part_of_the_key():
+    """The same tokens at different page sizes must NOT collide: a
+    page_size-4 digest can never alias a page_size-8 page in an
+    importer's registry (the /kv/import geometry check refuses the
+    payload first, but the keys must differ regardless)."""
+    seq = list(range(16))
+    h4 = chain_block_hashes(seq, 4)
+    h8 = chain_block_hashes(seq, 8)
+    assert len(h4) == 4 and len(h8) == 2
+    assert not set(h4) & set(h8)
+    # token-boundary ambiguity: [1, 23] vs [12, 3] style joins must
+    # hash differently (the digest separates tokens, not just bytes)
+    assert chain_block_hashes([1, 23, 0, 0], 4) \
+        != chain_block_hashes([12, 3, 0, 0], 4)
 
 
 def test_admit_miss_then_hit():

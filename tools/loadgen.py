@@ -13,7 +13,16 @@ should concentrate those requests on one replica and raise its
 prefix-cache hit counters; pointed straight at a replica it measures
 prefix-caching TTFT wins.
 
-Importable by tests (``run_load``) and runnable standalone:
+``--soak`` is the fleet mode: while the closed-loop load runs, every
+replica behind the router/control plane is rolled through
+drain -> (restart) -> undrain in sequence (``run_fleet_soak``); the
+pass property is zero dropped un-started requests, and against a
+disaggregated control plane the result also carries the
+/fleet/state transfer counters (kv_transfer_hit_rate, bytes, the
+disagg/direct split) and client-observed TTFT percentiles.
+
+Importable by tests (``run_load`` / ``run_fleet_soak``) and runnable
+standalone:
 
     python tools/loadgen.py --url http://127.0.0.1:8100 \
         --clients 8 --requests 16 --prefix-share 0.5 --json
@@ -62,10 +71,11 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     prefix = shared_prefix(shared_len, seed, vocab)
     lock = threading.Lock()
     latencies: List[float] = []
+    ttfts: List[float] = []
     shared_latencies: List[float] = []
     by_replica: Dict[str, int] = {}
     errors: List[str] = []
-    counts = {"sent": 0, "ok": 0, "shared": 0}
+    counts = {"sent": 0, "ok": 0, "shared": 0, "disaggregated": 0}
 
     def one_client(cid: int) -> None:
         rng = random.Random(seed * 1000 + cid)
@@ -85,14 +95,25 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
             t0 = time.monotonic()
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    resp.read()
+                    raw = resp.read()
                     routed = resp.headers.get("X-Routed-To")
                 dt = time.monotonic() - t0
+                try:  # /generate bodies carry ttft_s (replica-measured
+                    # direct, control-plane-measured across a
+                    # disaggregated handoff) + the handoff marker
+                    obj = json.loads(raw or b"{}")
+                    ttft = obj.get("ttft_s")
+                    disagg = bool(obj.get("disaggregated"))
+                except (ValueError, AttributeError):
+                    ttft, disagg = None, False
                 with lock:
                     counts["sent"] += 1
                     counts["ok"] += 1
                     counts["shared"] += int(is_shared)
+                    counts["disaggregated"] += int(disagg)
                     latencies.append(dt)
+                    if isinstance(ttft, (int, float)):
+                        ttfts.append(float(ttft))
                     if is_shared:
                         shared_latencies.append(dt)
                     if routed:
@@ -114,14 +135,103 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
         "sent": counts["sent"], "ok": counts["ok"],
         "failed": counts["sent"] - counts["ok"],
         "shared_prefix_requests": counts["shared"],
+        "disaggregated": counts["disaggregated"],
         "wall_s": wall,
         "rps": counts["ok"] / wall if wall > 0 else 0.0,
         "latency_p50_s": _percentile(latencies, 50),
         "latency_p95_s": _percentile(latencies, 95),
+        "ttft_p50_s": _percentile(ttfts, 50),
+        "ttft_p95_s": _percentile(ttfts, 95),
         "shared_latency_p50_s": _percentile(shared_latencies, 50),
         "by_replica": by_replica,
         "errors": errors[:20],
     }
+
+
+def _get_json(url: str, path: str, timeout: float = 10.0) -> Dict:
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _post_json(url: str, path: str, obj: Dict, timeout: float = 10.0) -> Dict:
+    req = urllib.request.Request(
+        url + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _wait_drained(url: str, rid: str, timeout: float = 30.0) -> bool:
+    """Poll the router snapshot until `rid` has zero outstanding
+    proxied requests (its in-flight work finished; only NEW requests
+    were being refused by the drain)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snaps = _get_json(url, "/router/replicas").get("replicas", [])
+        me = next((s for s in snaps if s["replica"] == rid), None)
+        if me is not None and int(me.get("outstanding", 0)) == 0:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_fleet_soak(url: str, clients: int = 4,
+                   requests_per_client: int = 8,
+                   prefix_share: float = 0.5, shared_len: int = 32,
+                   tail_len: int = 8, max_tokens: int = 8, seed: int = 0,
+                   vocab: int = 64, timeout: float = 120.0,
+                   replicas: Optional[List[str]] = None,
+                   restart_hook=None, settle_s: float = 0.3) -> Dict:
+    """Fleet soak: closed-loop load against a control plane WHILE every
+    replica is rolled through drain -> (restart) -> undrain, one at a
+    time. The pass/fail property is the router tier's: zero dropped
+    un-started requests — a drained/restarting replica stops receiving
+    new work, its in-flight work finishes, and the rest of the fleet
+    absorbs the traffic.
+
+    `restart_hook(rid)` (optional) bounces the replica between drain
+    and undrain — the in-process harness passes
+    ``fleet.by_rid[rid].restart``; against a real deployment the
+    operator's supervisor plays that part. Returns the load stats plus
+    the control plane's /fleet/state counters (kv_transfer_hit_rate,
+    transfer bytes/pages, disagg/direct split) and the rolling-cycle
+    log."""
+    if replicas is None:
+        replicas = [s["replica"] for s in
+                    _get_json(url, "/router/replicas").get("replicas", [])]
+    result: Dict = {}
+
+    def _load():
+        result.update(run_load(
+            url, clients=clients, requests_per_client=requests_per_client,
+            prefix_share=prefix_share, shared_len=shared_len,
+            tail_len=tail_len, max_tokens=max_tokens, seed=seed,
+            vocab=vocab, timeout=timeout))
+
+    t = threading.Thread(target=_load)
+    t.start()
+    cycles = []
+    for rid in replicas:
+        cycle = {"replica": rid}
+        _post_json(url, "/router/drain", {"replica": rid})
+        cycle["drained"] = _wait_drained(url, rid)
+        if restart_hook is not None:
+            restart_hook(rid)
+            cycle["restarted"] = True
+        time.sleep(settle_s)
+        _post_json(url, "/router/undrain", {"replica": rid})
+        cycles.append(cycle)
+        if t.is_alive():
+            time.sleep(settle_s)
+    t.join()
+    result["rolling_cycles"] = cycles
+    try:  # a plain (non-fleet) router has no /fleet/state — soak still valid
+        state = _get_json(url, "/fleet/state")
+        result["fleet_metrics"] = state.get("metrics", {})
+        result["fleet_tiers"] = state.get("tiers", {})
+    except (urllib.error.URLError, OSError, ValueError):
+        pass
+    return result
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -138,15 +248,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--max-tokens", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--path", default="/generate")
+    ap.add_argument("--soak", action="store_true",
+                    help="fleet soak mode: roll every replica through "
+                         "drain/undrain (discovered via "
+                         "/router/replicas) while the load runs; "
+                         "requires --url to be a router or fleet "
+                         "control plane")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
-    stats = run_load(args.url, clients=args.clients,
-                     requests_per_client=args.requests,
-                     prefix_share=args.prefix_share,
-                     shared_len=args.shared_len, tail_len=args.tail_len,
-                     max_tokens=args.max_tokens, seed=args.seed,
-                     path=args.path)
+    if args.soak:
+        stats = run_fleet_soak(args.url, clients=args.clients,
+                               requests_per_client=args.requests,
+                               prefix_share=args.prefix_share,
+                               shared_len=args.shared_len,
+                               tail_len=args.tail_len,
+                               max_tokens=args.max_tokens, seed=args.seed)
+    else:
+        stats = run_load(args.url, clients=args.clients,
+                         requests_per_client=args.requests,
+                         prefix_share=args.prefix_share,
+                         shared_len=args.shared_len, tail_len=args.tail_len,
+                         max_tokens=args.max_tokens, seed=args.seed,
+                         path=args.path)
     if args.json:
         print(json.dumps(stats, indent=2))
     else:
