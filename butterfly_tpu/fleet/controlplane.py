@@ -45,19 +45,41 @@ free_pages, and inflight_depth per replica (serve/server.py), so
 ``GET /fleet/state`` and the placement decision read one table with no
 second poll path.
 
+Observability plane (ISSUE 7, docs/observability.md §fleet tracing):
+
+* every proxied request is traced as control-plane LEG spans (classify,
+  prefill_leg, kv_export, kv_import, decode_leg / direct_leg, fallback)
+  under an ``X-Request-Id`` the handler mints when the client didn't,
+  and forwards on EVERY leg — so each replica's own tracer keys the
+  same id. ``GET /fleet/trace?request_id=`` joins the legs with the
+  involved replicas' timelines (``/debug/requests?request_id=``) on one
+  clock, using the per-replica clock offset the health prober estimates
+  from the probe RTT midpoint.
+* the prober also scrapes each replica's ``/metrics``;
+  ``GET /fleet/metrics`` re-exports the fleet rollup — counters summed,
+  histograms re-bucketed exactly (fixed shared ladders), per-replica
+  autoscale gauges labeled ``{replica=...}``.
+* declared SLOs (``--slo-ttft-ms`` / ``--slo-itl-ms``) are measured
+  across the whole handoff into ``fleet_slo_*`` counters and a rolling
+  burn-rate gauge.
+
 stdlib-only, like the rest of the router tier.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import time
 import urllib.error
 import urllib.request
-from collections import OrderedDict
+import uuid
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from butterfly_tpu.cache.prefix import chain_block_hashes
-from butterfly_tpu.obs.registry import LATENCY_BUCKETS, MetricsRegistry
+from butterfly_tpu.obs.registry import (
+    LATENCY_BUCKETS, MetricsRegistry, render_parsed, sum_expositions)
+from butterfly_tpu.obs.trace import Tracer, merge_fleet_trace
 from butterfly_tpu.router.policy import PrefixAffinityPolicy, affinity_key
 from butterfly_tpu.router.pool import Replica, ReplicaPool
 from butterfly_tpu.router.proxy import (
@@ -72,10 +94,26 @@ class ControlPlaneState(RouterState):
                  registry: Optional[MetricsRegistry] = None,
                  read_timeout: float = 300.0,
                  disagg_threshold: int = 64,
-                 handoff_timeout: float = 60.0):
+                 handoff_timeout: float = 60.0,
+                 slo_ttft_s: Optional[float] = None,
+                 slo_itl_s: Optional[float] = None,
+                 tracer: Optional[Tracer] = None):
         super().__init__(pool, policy, registry=registry,
                          read_timeout=read_timeout)
         self.page_size = policy.page_size
+        # Control-plane tracer: every proxied request gets a timeline of
+        # LEG spans (classify, prefill_leg, kv_export, kv_import,
+        # decode_leg, direct_leg, fallback) keyed by the same
+        # X-Request-Id the replicas trace under — GET /fleet/trace
+        # joins them into one cross-replica waterfall. Tracer's internal
+        # lock makes it safe for the handler threads.
+        self.tracer = tracer if tracer is not None else Tracer()
+        self._trace_ids = itertools.count()
+        # declared latency objectives, measured ACROSS the handoff (the
+        # latency the client sees, not any single replica's view)
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_itl_s = slo_itl_s
+        self._slo_window: deque = deque(maxlen=256)
         # predicted FRESH prefill tokens at which a request is worth
         # the handoff (two extra HTTP round trips + the page bytes)
         self.disagg_threshold = max(1, int(disagg_threshold))
@@ -116,6 +154,22 @@ class ControlPlaneState(RouterState):
             "Control-plane TTFT for disaggregated requests: client "
             "arrival to the prefill leg's first token, across the "
             "handoff", LATENCY_BUCKETS)
+        self._c_slo_ttft_ok = reg.counter(
+            "fleet_slo_ttft_ok_total",
+            "Disaggregated requests whose cross-handoff TTFT met the "
+            "declared objective (--slo-ttft-ms on the route CLI)")
+        self._c_slo_itl_ok = reg.counter(
+            "fleet_slo_itl_ok_total",
+            "Disaggregated requests whose mean inter-token gap met the "
+            "declared ITL objective")
+        self._c_slo_viol = reg.counter_family(
+            "fleet_slo_violations_total",
+            "Disaggregated requests that missed a declared latency "
+            "objective, by objective kind", ("kind",))
+        self._g_slo_burn = reg.gauge(
+            "fleet_slo_burn_rate",
+            "Fraction of the last 256 disaggregated requests that "
+            "violated ANY declared objective")
 
     # -- planning -----------------------------------------------------------
 
@@ -191,7 +245,133 @@ class ControlPlaneState(RouterState):
         }
         return {"replicas": snaps, "tiers": tiers,
                 "disagg_threshold": self.disagg_threshold,
+                "slo": {"ttft_s": self.slo_ttft_s,
+                        "itl_s": self.slo_itl_s},
                 "metrics": self.fleet_counters()}
+
+    # -- distributed tracing ------------------------------------------------
+
+    def begin_trace(self, request_id: str, **attrs) -> int:
+        """Open a control-plane timeline for one proxied request; the
+        returned tid keys this handler's span events. The client
+        request id is the cross-replica join key."""
+        tid = next(self._trace_ids)
+        self.tracer.begin_request(tid, request_id=request_id, **attrs)
+        return tid
+
+    def observe_slo(self, ttft_s: Optional[float],
+                    itl_mean_s: Optional[float]) -> Dict[str, bool]:
+        """Record one disaggregated request's attainment against the
+        declared objectives; returns the per-objective verdicts (empty
+        when no objective is declared)."""
+        out: Dict[str, bool] = {}
+        if self.slo_ttft_s is None and self.slo_itl_s is None:
+            return out
+        viol = False
+        with self._mlock:
+            if self.slo_ttft_s is not None:
+                ok = ttft_s is not None and ttft_s <= self.slo_ttft_s
+                out["slo_ttft_ok"] = ok
+                (self._c_slo_ttft_ok.inc() if ok
+                 else self._c_slo_viol.labels("ttft").inc())
+                viol |= not ok
+            if self.slo_itl_s is not None and itl_mean_s is not None:
+                ok = itl_mean_s <= self.slo_itl_s
+                out["slo_itl_ok"] = ok
+                (self._c_slo_itl_ok.inc() if ok
+                 else self._c_slo_viol.labels("itl").inc())
+                viol |= not ok
+            self._slo_window.append(1.0 if viol else 0.0)
+            self._g_slo_burn.set(sum(self._slo_window)
+                                 / len(self._slo_window))
+        return out
+
+    def assemble_trace(self, request_id: str) -> Optional[Dict]:
+        """The GET /fleet/trace body: this control plane's leg spans for
+        `request_id`, joined with every involved replica's own timeline
+        (fetched via /debug/requests?request_id=) on ONE clock — each
+        replica's monotonic events convert to its wall clock via its
+        tracer anchors, then shift by the health-probe clock-offset
+        estimate. A replica that is down (or restarted with a fresh
+        tracer) degrades to control-plane spans only, with its error
+        recorded under `sources`."""
+        tl = self.tracer.find_by_request_id(request_id)
+        if tl is None:
+            return None
+        rids: List[str] = []
+        for ev in tl["events"]:
+            rid = ev.get("replica")
+            if rid and rid not in rids:
+                rids.append(rid)
+        replicas: Dict[str, Dict] = {}
+        for rid in rids:
+            rep = self.pool.get(rid)
+            info: Dict = {"offset_s": rep.clock_offset if rep else None}
+            try:
+                url = (f"http://{rep.host}:{rep.port}/debug/requests"
+                       f"?request_id={request_id}") if rep else None
+                if url is None:
+                    raise LookupError(f"unknown replica {rid}")
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    info["dump"] = json.loads(resp.read() or b"{}")
+            except Exception as e:  # down/restarting: degrade, never 500
+                info["dump"] = None
+                info["error"] = f"{type(e).__name__}: {e}"
+            replicas[rid] = info
+        return merge_fleet_trace(
+            request_id,
+            {"timeline": tl, "t0_wall": self.tracer.t0_wall,
+             "t0_monotonic": self.tracer.t0_monotonic},
+            replicas)
+
+    # -- fleet metrics rollup -----------------------------------------------
+
+    #: replica flat-dict gauges re-exported per replica from the scrape
+    #: (the autoscale signal surface ROADMAP item 3 reads); everything
+    #: else gauge-typed is dropped from the rollup — summing uptimes or
+    #: queue-depth snapshots across replicas is not a meaningful series.
+    AUTOSCALE_GAUGES = ("queue_depth", "active_requests", "kv_pages_free",
+                        "kv_pages_total", "inflight_depth",
+                        "tokens_per_sec", "device_bubble_p50",
+                        "device_bubble_p95", "slo_burn_rate")
+
+    def fleet_metrics_text(self) -> str:
+        """The GET /fleet/metrics body: one exposition aggregating every
+        replica's last-scraped /metrics. Counters sum; histograms sum
+        bucket-wise (exact — the registry's fixed ladders are identical
+        across replicas, and mismatched ladders are dropped rather than
+        mis-summed); per-replica autoscale gauges ride along labeled
+        {replica="host:port"}. Replica families re-export namespaced
+        butterfly_fleet_*."""
+        by_rid = self.pool.metrics_by_replica()
+        agg = sum_expositions(list(by_rid.values()))
+
+        def rename(name: str) -> str:
+            return name.replace("butterfly_", "butterfly_fleet_", 1) \
+                if name.startswith("butterfly_") else "fleet_" + name
+
+        lines = render_parsed(agg, rename=rename)
+        lines.append("# HELP butterfly_fleet_replicas_scraped Replicas "
+                     "contributing to this rollup (last /metrics scrape "
+                     "retained through transient failures)")
+        lines.append("# TYPE butterfly_fleet_replicas_scraped gauge")
+        lines.append(f"butterfly_fleet_replicas_scraped {len(by_rid)}")
+        # per-replica autoscale gauges, from each replica's own scrape
+        per_rep: Dict[str, List[Tuple[str, float]]] = {}
+        for rid, families in sorted(by_rid.items()):
+            for key in self.AUTOSCALE_GAUGES:
+                fam = families.get(f"butterfly_{key}")
+                if not fam:
+                    continue
+                v = fam["samples"].get((f"butterfly_{key}", ()))
+                if v is not None:
+                    per_rep.setdefault(key, []).append((rid, v))
+        for key, samples in sorted(per_rep.items()):
+            full = f"butterfly_fleet_replica_{key}"
+            lines.append(f"# TYPE {full} gauge")
+            lines.extend(f'{full}{{replica="{rid}"}} {v:g}'
+                         for rid, v in samples)
+        return "\n".join(lines) + ("\n" if lines else "")
 
 
 def make_fleet_handler(state: ControlPlaneState):
@@ -203,12 +383,56 @@ def make_fleet_handler(state: ControlPlaneState):
     class FleetHandler(Base):
 
         def do_GET(self):
-            if self.path.split("?")[0] == "/fleet/state":
+            path = self.path.split("?")[0]
+            if path == "/fleet/state":
                 self._json(200, state.fleet_state())
+            elif path == "/fleet/trace":
+                self._fleet_trace()
+            elif path == "/fleet/metrics":
+                body = state.fleet_metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 Base.do_GET(self)
 
+        def _fleet_trace(self) -> None:
+            from urllib.parse import parse_qs, urlparse
+            qs = parse_qs(urlparse(self.path).query)
+            rid = qs.get("request_id", [None])[0]
+            if not rid:
+                self._json(400, {"error": "missing ?request_id= (the "
+                                          "X-Request-Id / request_id the "
+                                          "request was tagged with)"})
+                return
+            merged = state.assemble_trace(str(rid)[:128])
+            if merged is None:
+                self._json(404, {"error": f"no control-plane timeline "
+                                          f"for request_id {rid!r} "
+                                          f"(evicted or never seen)"})
+            else:
+                self._json(200, merged)
+
         # -- classification ---------------------------------------------------
+
+        def _ensure_request_id(self, obj) -> str:
+            """The distributed trace id: client header wins, then a
+            request_id body field, else one is minted. Injected into
+            self.headers so the inherited proxy forwards it on direct
+            dispatches — every replica then traces under the SAME id
+            the control plane does."""
+            rid = self.headers.get("X-Request-Id") \
+                or (obj.get("request_id") if isinstance(obj, dict)
+                    else None)
+            rid = str(rid)[:128] if rid else \
+                f"fleet-{uuid.uuid4().hex[:12]}"
+            if self.headers.get("X-Request-Id") != rid:
+                del self.headers["X-Request-Id"]
+                self.headers["X-Request-Id"] = rid
+            return rid
 
         def _proxy(self, path: str) -> None:
             try:
@@ -221,18 +445,35 @@ def make_fleet_handler(state: ControlPlaneState):
                 obj = json.loads(body or b"{}")
             except (ValueError, UnicodeDecodeError):
                 obj = None
+            t_arrive = time.monotonic()
+            request_id = self._ensure_request_id(obj)
             ids = self._token_ids(obj)
+            tid = state.begin_trace(request_id, path=path,
+                                    prompt_len=len(ids) if ids else None)
             plan = self._disagg_plan(path, obj, ids)
+            state.tracer.event(
+                tid, "classify", dur_s=time.monotonic() - t_arrive,
+                decision="disagg" if plan else "direct",
+                predicted_cost=state.predicted_cost(ids) if ids else None,
+                threshold=state.disagg_threshold)
             if plan is None:
                 state.inc(state._c_direct)
                 if ids:
                     state.note_seen(ids)
                 route_tokens = extract_route_tokens(body)
-                self._dispatch(path, body,
-                               *state.direct_plan(route_tokens))
+                t0 = time.monotonic()
+                served = self._dispatch(path, body,
+                                        *state.direct_plan(route_tokens))
+                state.tracer.event(tid, "direct_leg",
+                                   dur_s=time.monotonic() - t0,
+                                   replica=served,
+                                   status="ok" if served else "failed")
+                state.tracer.event(tid, "finish", state="direct",
+                                   total_s=time.monotonic() - t_arrive)
                 return
             pre, dec = plan
-            self._disaggregate(obj, ids, pre, dec)
+            self._disaggregate(obj, ids, pre, dec, tid=tid,
+                               request_id=request_id, t_arrive=t_arrive)
 
         def _token_ids(self, obj) -> Optional[List[int]]:
             """Explicit token ids only: a string prompt would hash its
@@ -275,14 +516,20 @@ def make_fleet_handler(state: ControlPlaneState):
         # -- the handoff ------------------------------------------------------
 
         def _call(self, rep: Replica, method: str, path: str,
-                  obj=None, timeout: Optional[float] = None):
+                  obj=None, timeout: Optional[float] = None,
+                  request_id: Optional[str] = None):
             """One control-plane HTTP call with pool feedback. Returns
-            (status, parsed body) — status None on transport failure."""
+            (status, parsed body) — status None on transport failure.
+            `request_id` rides as X-Request-Id so the replica's tracer
+            (and its kv-transfer error bodies) key the same distributed
+            request the control plane is tracing."""
             url = f"http://{rep.host}:{rep.port}{path}"
             data = json.dumps(obj).encode() if obj is not None else None
+            headers = {"Content-Type": "application/json"}
+            if request_id:
+                headers["X-Request-Id"] = request_id
             req = urllib.request.Request(
-                url, data=data, method=method,
-                headers={"Content-Type": "application/json"})
+                url, data=data, method=method, headers=headers)
             state.pool.note_dispatch(rep.rid)
             try:
                 with urllib.request.urlopen(
@@ -303,29 +550,47 @@ def make_fleet_handler(state: ControlPlaneState):
             finally:
                 state.pool.note_done(rep.rid)
 
-        def _fallback(self, obj, ids) -> None:
+        def _fallback(self, obj, ids, tid, t_arrive, reason) -> None:
             """A handoff leg failed before any client byte: re-dispatch
             the ORIGINAL request direct (the decode replica recomputes
             the whole prompt — slower, never wrong)."""
             state.inc(state._c_fallback)
+            state.tracer.event(tid, "fallback", reason=reason)
             body = json.dumps(obj).encode()
-            self._dispatch("/generate", body, *state.direct_plan(ids))
+            t0 = time.monotonic()
+            served = self._dispatch("/generate", body,
+                                    *state.direct_plan(ids))
+            state.tracer.event(tid, "direct_leg",
+                               dur_s=time.monotonic() - t0,
+                               replica=served,
+                               status="ok" if served else "failed")
+            state.tracer.event(tid, "finish", state="fallback",
+                               total_s=time.monotonic() - t_arrive)
 
         def _disaggregate(self, obj: dict, ids: List[int],
-                          pre: Replica, dec: Replica) -> None:
-            t0 = time.monotonic()
+                          pre: Replica, dec: Replica, tid: int,
+                          request_id: str, t_arrive: float) -> None:
+            t0 = t_arrive  # TTFT/total measure from client arrival
             state.inc(state._c_disagg)
             max_tokens = int(obj.get("max_tokens",
                                      obj.get("max_new_tokens", 64)))
             # 1. prefill leg: full prompt + first token on the prefill tier
-            a_req = {"tokens": ids, "max_tokens": 1}
-            for k in ("temperature", "stop_token", "request_id"):
+            a_req = {"tokens": ids, "max_tokens": 1,
+                     "request_id": request_id}
+            for k in ("temperature", "stop_token"):
                 if k in obj:
                     a_req[k] = obj[k]
+            t_leg = time.monotonic()
             code, a = self._call(pre, "POST", "/generate", a_req,
-                                 timeout=state.handoff_timeout)
+                                 timeout=state.handoff_timeout,
+                                 request_id=request_id)
+            state.tracer.event(tid, "prefill_leg",
+                               dur_s=time.monotonic() - t_leg,
+                               replica=pre.rid,
+                               status="ok" if code == 200 else f"{code}")
             if code != 200 or not a.get("tokens"):
-                self._fallback(obj, ids)
+                self._fallback(obj, ids, tid, t_arrive,
+                               f"prefill leg {code}")
                 return
             ttft = time.monotonic() - t0
             state.observe(state._h_ttft, ttft)
@@ -336,20 +601,28 @@ def make_fleet_handler(state: ControlPlaneState):
             hashes = [h.hex() for h in chain_block_hashes(ids,
                                                           state.page_size)]
             if hashes:
+                t_leg = time.monotonic()
                 code, exp = self._call(
                     pre, "GET", "/kv/pages?hashes=" + ",".join(hashes),
-                    timeout=state.handoff_timeout)
+                    timeout=state.handoff_timeout, request_id=request_id)
+                n_pages = len(exp.get("pages", ())) if code == 200 else 0
+                state.tracer.event(
+                    tid, "kv_export", dur_s=time.monotonic() - t_leg,
+                    replica=pre.rid, pages=n_pages,
+                    bytes=int(exp.get("bytes", 0)) if code == 200 else 0,
+                    status="ok" if code == 200 else f"{code}")
                 if code == 200:
-                    n_pages = len(exp.get("pages", ()))
                     state.add(state._c_xfer_hits, n_pages)
                     state.add(state._c_xfer_miss,
                               len(exp.get("missing", ())))
                     state.add(state._c_xfer_bytes,
                               int(exp.get("bytes", 0)))
                     if n_pages:
+                        t_leg = time.monotonic()
                         code, imp = self._call(dec, "POST", "/kv/import",
                                                exp,
-                                               timeout=state.handoff_timeout)
+                                               timeout=state.handoff_timeout,
+                                               request_id=request_id)
                         if code == 200:
                             # skipped = already cached on B (an earlier
                             # transfer or B's own traffic): warm either
@@ -357,37 +630,62 @@ def make_fleet_handler(state: ControlPlaneState):
                             imported = int(imp.get("imported", 0)) \
                                 + int(imp.get("skipped", 0))
                             state.add(state._c_xfer_pages, imported)
+                        state.tracer.event(
+                            tid, "kv_import",
+                            dur_s=time.monotonic() - t_leg,
+                            replica=dec.rid, imported=imported,
+                            status="ok" if code == 200 else f"{code}")
             state.note_seen(ids)
             meta = {"disaggregated": True, "prefill_replica": pre.rid,
-                    "decode_replica": dec.rid,
+                    "decode_replica": dec.rid, "request_id": request_id,
                     "kv_pages_imported": imported, "ttft_s": ttft}
             # 3. decode leg: prompt + first token, remaining budget.
             # Admission on B prefix-hits the imported pages and
             # prefills only the partial trailing block.
             if max_tokens <= 1 or a.get("stopped"):
                 self._finish_disagg(t0, first, a.get("text", ""),
-                                    a.get("stopped", False), meta, dec.rid)
+                                    a.get("stopped", False), meta, dec.rid,
+                                    tid)
                 return
-            b_req = {"tokens": ids + first, "max_tokens": max_tokens - 1}
-            for k in ("temperature", "stop_token", "top_p", "top_k",
-                      "request_id"):
+            b_req = {"tokens": ids + first, "max_tokens": max_tokens - 1,
+                     "request_id": request_id}
+            for k in ("temperature", "stop_token", "top_p", "top_k"):
                 if k in obj:
                     b_req[k] = obj[k]
-            code, b = self._call(dec, "POST", "/generate", b_req)
+            t_leg = time.monotonic()
+            code, b = self._call(dec, "POST", "/generate", b_req,
+                                 request_id=request_id)
+            state.tracer.event(tid, "decode_leg",
+                               dur_s=time.monotonic() - t_leg,
+                               replica=dec.rid,
+                               tokens=len(b.get("tokens", ())),
+                               status="ok" if code == 200 else f"{code}")
             if code != 200:
-                self._fallback(obj, ids)
+                self._fallback(obj, ids, tid, t_arrive,
+                               f"decode leg {code}")
                 return
             self._finish_disagg(
                 t0, first + [int(t) for t in b.get("tokens", ())],
                 a.get("text", "") + b.get("text", ""),
-                b.get("stopped", False), meta, dec.rid)
+                b.get("stopped", False), meta, dec.rid, tid)
 
         def _finish_disagg(self, t0, tokens, text, stopped, meta,
-                           rid) -> None:
+                           rid, tid) -> None:
             state.count(rid, "ok")
+            total = time.monotonic() - t0
+            ttft = meta.get("ttft_s")
+            itl_mean = ((total - ttft) / (len(tokens) - 1)
+                        if ttft is not None and len(tokens) > 1 else None)
+            verdicts = state.observe_slo(ttft, itl_mean)
+            attrs = dict(verdicts)
+            if itl_mean is not None:
+                attrs["itl_mean_s"] = itl_mean
+            state.tracer.event(tid, "finish", state="disaggregated",
+                               tokens=len(tokens), total_s=total,
+                               ttft_s=ttft, **attrs)
             self._json(200, {
                 "tokens": tokens, "text": text, "stopped": stopped,
-                "total_s": time.monotonic() - t0, **meta,
+                "total_s": total, **meta, **verdicts,
             }, headers={"X-Routed-To": rid})
 
     return FleetHandler
@@ -399,6 +697,8 @@ def fleet_forever(backends: List[str], host: str = "0.0.0.0",
                   probe_interval: float = 0.5, probe_timeout: float = 2.0,
                   dead_after: int = 3, read_timeout: float = 300.0,
                   disagg_threshold: int = 64,
+                  slo_ttft_s: Optional[float] = None,
+                  slo_itl_s: Optional[float] = None,
                   ready_event=None):
     """Blocking control-plane loop (`butterfly route --disaggregate`).
     Same shape as router.proxy.route_forever — the control plane IS the
@@ -409,13 +709,14 @@ def fleet_forever(backends: List[str], host: str = "0.0.0.0",
     registry = MetricsRegistry()
     pool = ReplicaPool(backends, probe_interval=probe_interval,
                        probe_timeout=probe_timeout, dead_after=dead_after,
-                       registry=registry)
+                       registry=registry, scrape_metrics=True)
     policy = PrefixAffinityPolicy(pool, page_size=page_size,
                                   affinity_blocks=affinity_blocks,
                                   saturate_after=saturate_after)
     state = ControlPlaneState(pool, policy, registry=registry,
                               read_timeout=read_timeout,
-                              disagg_threshold=disagg_threshold)
+                              disagg_threshold=disagg_threshold,
+                              slo_ttft_s=slo_ttft_s, slo_itl_s=slo_itl_s)
     pool.probe_all()   # one synchronous round: roles known at bind
     pool.start()
 

@@ -101,12 +101,18 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
                   max_batch: int = 2, max_seq: int = 128,
                   num_pages: Optional[int] = None,
                   host: str = "127.0.0.1", warm: bool = True,
-                  warm_len: Optional[int] = None) -> ReplicaHandle:
+                  warm_len: Optional[int] = None,
+                  slo_ttft_s: Optional[float] = None,
+                  slo_itl_s: Optional[float] = None) -> ReplicaHandle:
     """One in-process serve replica on a fresh loopback port. Prefix
     caching is always on — it is the registry KV transfer addresses
-    pages through. Warming runs BEFORE the scheduler loop thread
-    starts (one thread ticks a scheduler, ever)."""
+    pages through. Tracing is always on — the fleet trace merge
+    (GET /fleet/trace) joins each replica's /debug/requests timeline
+    into the cross-replica waterfall, exactly like a real `butterfly
+    serve` replica (which traces by default). Warming runs BEFORE the
+    scheduler loop thread starts (one thread ticks a scheduler, ever)."""
     from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.obs.trace import Tracer
     from butterfly_tpu.sched.scheduler import Scheduler
     from butterfly_tpu.serve.server import ServerState, make_handler
     from butterfly_tpu.utils.tokenizer import ByteTokenizer
@@ -114,7 +120,8 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
     rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
                        page_size=page_size, num_pages=num_pages,
                        prefix_caching=True)
-    sched = Scheduler(ServingEngine(model, params, rt))
+    sched = Scheduler(ServingEngine(model, params, rt), tracer=Tracer(),
+                      slo_ttft_s=slo_ttft_s, slo_itl_s=slo_itl_s)
     if warm:
         # compile prefill + decode off any measured clock, BOTH prefill
         # flavors: the first warm prompt runs the fresh program, the
@@ -140,7 +147,9 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
                 disagg_threshold: int = 16, affinity_blocks: int = 4,
                 probe_interval: float = 0.2, model=None, params=None,
                 warm: bool = True,
-                warm_len: Optional[int] = None) -> FleetHandle:
+                warm_len: Optional[int] = None,
+                slo_ttft_s: Optional[float] = None,
+                slo_itl_s: Optional[float] = None) -> FleetHandle:
     """Spin the whole topology: replicas (one shared tiny-model param
     tree unless the caller provides model+params) + control plane, and
     optionally warm every replica's serving programs so the first
@@ -160,16 +169,20 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
     replicas = [start_replica(model, params, role, page_size=page_size,
                               max_batch=max_batch, max_seq=max_seq,
                               num_pages=num_pages, warm=warm,
-                              warm_len=warm_len)
+                              warm_len=warm_len, slo_ttft_s=slo_ttft_s,
+                              slo_itl_s=slo_itl_s)
                 for role in roles]
     registry = MetricsRegistry()
     pool = ReplicaPool([r.rid for r in replicas],
-                       probe_interval=probe_interval, registry=registry)
+                       probe_interval=probe_interval, registry=registry,
+                       scrape_metrics=True)
     policy = PrefixAffinityPolicy(pool, page_size=page_size,
                                   affinity_blocks=affinity_blocks)
     cp_state = ControlPlaneState(pool, policy, registry=registry,
                                  read_timeout=120.0,
-                                 disagg_threshold=disagg_threshold)
+                                 disagg_threshold=disagg_threshold,
+                                 slo_ttft_s=slo_ttft_s,
+                                 slo_itl_s=slo_itl_s)
     pool.probe_all()  # learn roles before the first request routes
     pool.start()
     cp_httpd = ThreadingHTTPServer(("127.0.0.1", 0),

@@ -298,6 +298,8 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
                         page_size: int = 8, max_batch: int = 2,
                         disagg_threshold: int = 16,
                         prefix_share: float = 0.5,
+                        slo_ttft_ms: float = 2000.0,
+                        slo_itl_ms: float = 500.0,
                         seed: int = 0) -> Dict:
     """Fleet soak benchmark: an in-process disaggregated topology
     (fleet/harness.py — tiny model always: the fleet numbers measure
@@ -310,7 +312,10 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
     drain/restart for the after-TTFT, the transfer counters, and the
     zero-drop property. Emits the fleet_* keys the bench JSON carries:
     fleet_ttft_p50/p95 (+ the direct-phase _direct twins),
-    kv_transfer_bytes, kv_transfer_hit_rate, drop counts."""
+    kv_transfer_bytes, kv_transfer_hit_rate, drop counts, and — against
+    the declared CPU-smoke objectives — the soak's client-measured
+    fleet_slo_attainment (loadgen judges every response against
+    slo_ttft_ms/slo_itl_ms)."""
     from butterfly_tpu.fleet.harness import start_fleet
 
     lg = _loadgen()
@@ -320,6 +325,8 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
                         max_batch=max_batch,
                         max_seq=shared_len + tail + max_tokens + 16,
                         disagg_threshold=disagg_threshold,
+                        slo_ttft_s=slo_ttft_ms / 1e3,
+                        slo_itl_s=slo_itl_ms / 1e3,
                         # warm at the workload's prompt length so phase
                         # 1 (the before-TTFT) doesn't eat the XLA
                         # compile for the workload's prefill bucket
@@ -340,7 +347,8 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
             prefix_share=prefix_share, shared_len=shared_len,
             tail_len=tail, max_tokens=max_tokens, seed=seed + 1,
             replicas=fleet.rids,
-            restart_hook=lambda rid: fleet.by_rid[rid].restart())
+            restart_hook=lambda rid: fleet.by_rid[rid].restart(),
+            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
     finally:
         fleet.stop()
     fm = soak.get("fleet_metrics", {})
@@ -358,4 +366,9 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
         "kv_transfer_pages": fm.get("kv_transfer_pages", 0.0),
         "kv_transfer_hit_rate": fm.get("kv_transfer_hit_rate", 0.0),
         "fleet_rolling_cycles": len(soak.get("rolling_cycles", ())),
+        # client-measured SLO attainment during the soak, against the
+        # declared objectives (also in the JSON so regressions show)
+        "fleet_slo_ttft_ms": slo_ttft_ms,
+        "fleet_slo_itl_ms": slo_itl_ms,
+        "fleet_slo_attainment": soak.get("slo_attainment"),
     }

@@ -333,3 +333,142 @@ class MetricsRegistry:
         for _, inst in insts:
             lines.extend(inst.render(self.prefix))
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- exposition parsing + fleet aggregation ----------------------------------
+#
+# The fleet control plane scrapes each replica's /metrics text and
+# re-exports a rollup (GET /fleet/metrics): counters sum exactly, and
+# because every replica's histograms use the SAME fixed bucket ladders
+# (above), summing the cumulative per-le bucket series is an EXACT
+# re-bucketing — no interpolation, no resolution loss. Gauges do not
+# aggregate meaningfully by summation (uptime, queue depth snapshots),
+# so the rollup drops them; the control plane re-exposes the autoscale
+# gauges per replica with a {replica=...} label instead.
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> Dict[str, Dict]:
+    """Parse Prometheus text into families.
+
+    Returns ``{family_name: {"type": kind, "help": str, "samples":
+    {(series_name, labels): value}}}`` where ``labels`` is a sorted
+    tuple of (label, value) pairs. The ``_bucket``/``_sum``/``_count``
+    series of a ``# TYPE name histogram`` family fold under the family
+    name. Unparseable lines are skipped (scrapes must never fail on a
+    foreign exporter's extension).
+    """
+    families: Dict[str, Dict] = {}
+    types: Dict[str, str] = {}
+
+    def fam(name: str) -> Dict:
+        f = families.get(name)
+        if f is None:
+            f = families[name] = {"type": types.get(name, "untyped"),
+                                  "help": "", "samples": {}}
+        return f
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+                fam(parts[2])["type"] = parts[3].strip()
+            elif len(parts) >= 4 and parts[1] == "HELP":
+                fam(parts[2])["help"] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        series, raw_labels, raw_val = m.groups()
+        try:
+            value = float(raw_val)
+        except ValueError:
+            continue
+        name = series
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = series[:-len(suffix)] if series.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                name = base
+                break
+        labels = tuple(sorted(
+            (k, _unescape_label(v))
+            for k, v in _LABEL_RE.findall(raw_labels or "")))
+        fam(name)["samples"][(series, labels)] = value
+    return families
+
+
+def _bucket_ladder(family: Dict) -> frozenset:
+    """The set of `le` bounds a parsed histogram family exposes."""
+    return frozenset(
+        dict(labels).get("le") for series, labels in family["samples"]
+        if series.endswith("_bucket"))
+
+
+def sum_expositions(parsed: Sequence[Dict[str, Dict]]) -> Dict[str, Dict]:
+    """Merge parsed expositions from N processes into one rollup.
+
+    Counter samples sum per (series, labels); histogram families sum
+    their cumulative bucket/_sum/_count series — exact when every
+    process exposes the same ladder, and a family whose ladders
+    DISAGREE across processes is dropped entirely (a partial sum would
+    render a histogram whose +Inf != _count). Gauge and untyped
+    families are dropped (see module comment).
+    """
+    out: Dict[str, Dict] = {}
+    dropped: set = set()
+    for p in parsed:
+        for name, family in p.items():
+            kind = family["type"]
+            if kind not in ("counter", "histogram") or name in dropped:
+                continue
+            agg = out.get(name)
+            if agg is None:
+                agg = out[name] = {"type": kind, "help": family["help"],
+                                   "samples": {}}
+            if kind == "histogram" and agg["samples"] and \
+                    _bucket_ladder(agg) != _bucket_ladder(family):
+                del out[name]
+                dropped.add(name)
+                continue
+            for key, v in family["samples"].items():
+                agg["samples"][key] = agg["samples"].get(key, 0.0) + v
+    return out
+
+
+def render_parsed(families: Dict[str, Dict],
+                  rename=None) -> List[str]:
+    """Parsed/aggregated families back to exposition lines. `rename`
+    maps a family name to its exported name (the fleet rollup namespaces
+    `butterfly_*` as `butterfly_fleet_*`); series suffixes and labels
+    are preserved."""
+    lines: List[str] = []
+    for name in sorted(families):
+        family = families[name]
+        new = rename(name) if rename is not None else name
+        if family["help"]:
+            lines.append(f"# HELP {new} {family['help']}")
+        lines.append(f"# TYPE {new} {family['type']}")
+        for (series, labels), v in sorted(family["samples"].items()):
+            s = new + series[len(name):]
+            if labels:
+                lbl = ",".join(f'{k}="{_escape_label(v2)}"'
+                               for k, v2 in labels)
+                s += "{" + lbl + "}"
+            # bucket/count series render as integers when whole
+            lines.append(f"{s} {_fmt(v)}")
+        # histogram series order: render() above sorts _bucket lines by
+        # the stringified le bound — fine for consumers that key on the
+        # le label (Prometheus does), and stable across scrapes
+    return lines

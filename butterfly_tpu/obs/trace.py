@@ -94,31 +94,46 @@ class Tracer:
             return {"id": rec["id"], "request_id": rec["request_id"],
                     "done": rec["done"], "events": list(rec["events"])}
 
-    def timelines(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
-        """Most recent `n` request timelines, oldest first."""
+    def timelines(self, n: Optional[int] = None,
+                  request_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Most recent `n` request timelines, oldest first. `request_id`
+        filters to timelines carrying that client id — the cross-replica
+        join key: a fleet control plane asks each replica for exactly the
+        timelines of ONE distributed request."""
         with self._lock:
             recs = [{"id": r["id"], "request_id": r["request_id"],
                      "done": r["done"], "events": list(r["events"])}
-                    for r in self._requests.values()]
+                    for r in self._requests.values()
+                    if request_id is None or r["request_id"] == request_id]
         if n is not None and n >= 0:
-            recs = recs[-n:]
+            recs = recs[-n:] if n else []  # [-0:] would be the whole list
         return recs
+
+    def find_by_request_id(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """Newest timeline tagged with `request_id` (newest wins: a
+        retried client id maps to its latest attempt)."""
+        recs = self.timelines(request_id=request_id)
+        return recs[-1] if recs else None
 
     def global_events(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
         with self._lock:
             evs = list(self._global)
         if n is not None and n >= 0:
-            evs = evs[-n:]
+            evs = evs[-n:] if n else []  # [-0:] would be the whole list
         return evs
 
     def dump(self, n_requests: Optional[int] = None,
-             n_global: Optional[int] = None) -> Dict[str, Any]:
+             n_global: Optional[int] = None,
+             request_id: Optional[str] = None) -> Dict[str, Any]:
         """JSON-ready snapshot: what /debug/requests returns and what
-        tools/trace_report.py consumes."""
+        tools/trace_report.py consumes. The `t0_wall`/`t0_monotonic`
+        anchors let offline tools place every monotonic event timestamp
+        on wall-clock time (and a fleet merge place several processes'
+        events on ONE clock)."""
         return {
             "t0_monotonic": self.t0_monotonic,
             "t0_wall": self.t0_wall,
-            "requests": self.timelines(n_requests),
+            "requests": self.timelines(n_requests, request_id=request_id),
             "global_events": self.global_events(n_global),
         }
 
@@ -172,4 +187,97 @@ def summarize_timeline(rec: Dict[str, Any]) -> Dict[str, Any]:
         "prefill_chunks": chunks,
         "preemptions": preempts,
         "events": len(rec.get("events", ())),
+    }
+
+
+# -- fleet trace merging ------------------------------------------------------
+#
+# A disaggregated request crosses processes: the control plane runs the
+# legs (classify, prefill_leg, kv_export, kv_import, decode_leg), each
+# replica records its own per-request timeline. All timestamps are
+# per-process time.monotonic(); each tracer's t0_wall/t0_monotonic
+# anchors convert them to that PROCESS's wall clock, and a per-replica
+# clock offset (estimated from the health-probe RTT midpoint,
+# router/pool.py) places them on the control plane's clock:
+#
+#     t_cp_wall = t0_wall + (t - t0_monotonic) - offset_s
+#
+# where offset_s = replica_wall - control_wall at probe time. On one
+# host the offsets are ~0; across hosts they absorb NTP skew down to
+# half the probe RTT. Everything here is pure-dict stdlib so
+# tools/trace_report.py renders a dumped merged trace with no backend.
+
+def events_to_wall(events: List[Dict[str, Any]], t0_wall: float,
+                   t0_monotonic: float,
+                   offset_s: float = 0.0) -> List[Dict[str, Any]]:
+    """Copy `events`, adding `t_wall` (control-plane wall clock)."""
+    out = []
+    for ev in events:
+        ev2 = dict(ev)
+        ev2["t_wall"] = t0_wall + (ev["t"] - t0_monotonic) - offset_s
+        out.append(ev2)
+    return out
+
+
+def merge_fleet_trace(request_id: str, control: Dict[str, Any],
+                      replicas: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Assemble one request's cross-replica waterfall.
+
+    `control`: {"timeline": <Tracer timeline>, "t0_wall": ...,
+    "t0_monotonic": ...} — the control plane's own span record.
+    `replicas`: {rid: {"dump": <the /debug/requests?request_id= body,
+    or None if unreachable>, "offset_s": float|None, "error": str}}.
+
+    Returns the /fleet/trace body: `merged` (every event from every
+    source on the control plane's wall clock, time-sorted, each tagged
+    `source`), `legs` (control-plane spans with durations, waterfall
+    order), and `sources` (per-source event counts; a missing replica
+    degrades to control-plane spans only, with its error recorded).
+    """
+    cp_events = events_to_wall(control["timeline"].get("events", ()),
+                               control["t0_wall"], control["t0_monotonic"])
+    merged = [{**ev, "source": "control"} for ev in cp_events]
+    sources: Dict[str, Dict[str, Any]] = {
+        "control": {"events": len(cp_events), "offset_s": 0.0}}
+    for rid, info in replicas.items():
+        dump = info.get("dump")
+        if not dump or not dump.get("requests"):
+            sources[rid] = {"events": 0, "missing": True,
+                            "offset_s": info.get("offset_s"),
+                            "error": info.get("error",
+                                              "no timeline for request")}
+            continue
+        offset = info.get("offset_s") or 0.0
+        n = 0
+        for rec in dump["requests"]:
+            evs = events_to_wall(rec.get("events", ()),
+                                 dump.get("t0_wall", 0.0),
+                                 dump.get("t0_monotonic", 0.0), offset)
+            merged.extend({**ev, "source": rid,
+                           "replica_req": rec.get("id")} for ev in evs)
+            n += len(evs)
+        sources[rid] = {"events": n, "offset_s": offset,
+                        "estimated_offset": info.get("offset_s") is not None}
+    merged.sort(key=lambda ev: ev["t_wall"])
+    # control-plane leg spans: events carrying dur_s were recorded at
+    # leg END, so the span is [t_wall - dur_s, t_wall]
+    legs = [{"name": ev["name"], "replica": ev.get("replica"),
+             "start_wall": ev["t_wall"] - float(ev["dur_s"]),
+             "end_wall": ev["t_wall"], "dur_s": float(ev["dur_s"]),
+             **({"status": ev["status"]} if "status" in ev else {})}
+            for ev in cp_events if "dur_s" in ev]
+    legs.sort(key=lambda leg: leg["start_wall"])
+    finish = next((ev for ev in reversed(cp_events)
+                   if ev["name"] == "finish"), {})
+    return {
+        "request_id": request_id,
+        "t0_wall": merged[0]["t_wall"] if merged else None,
+        "total_s": finish.get("total_s"),
+        "legs_total_s": sum(leg["dur_s"] for leg in legs),
+        "legs": legs,
+        "merged": merged,
+        "sources": sources,
+        "slo": {k: finish[k] for k in
+                ("slo_ttft_ok", "slo_itl_ok", "ttft_s", "itl_mean_s")
+                if k in finish} or None,
     }

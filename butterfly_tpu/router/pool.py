@@ -50,7 +50,8 @@ class Replica:
     __slots__ = ("rid", "host", "port", "liveness", "drain", "outstanding",
                  "queue_depth", "active", "fails", "probes", "last_probe_t",
                  "next_probe_t", "last_error", "role", "free_pages",
-                 "inflight")
+                 "inflight", "clock_offset", "metrics_families",
+                 "metrics_t")
 
     def __init__(self, rid: str, host: str, port: int):
         self.rid = rid
@@ -67,6 +68,18 @@ class Replica:
         self.role = "both"       # fleet tier (prefill|decode|both), scraped
         self.free_pages: Optional[int] = None  # KV page headroom, scraped
         self.inflight = 0        # decode blocks in flight, scraped
+        # estimated replica_wall - router_wall clock offset (seconds),
+        # from the /health probe RTT midpoint: the replica stamps
+        # `now_wall` into its response, and offset = now_wall - the
+        # midpoint of our send/receive wall times. Accurate to ~RTT/2 —
+        # what the fleet trace merge needs to place a replica's span
+        # events on the control plane's clock. None until a probe with
+        # a now_wall-carrying replica lands.
+        self.clock_offset: Optional[float] = None
+        # last parsed /metrics exposition (obs.registry.parse_exposition
+        # output) when the pool scrapes metrics; feeds /fleet/metrics
+        self.metrics_families: Optional[dict] = None
+        self.metrics_t: Optional[float] = None
         self.fails = 0           # consecutive probe/connect failures
         self.probes = 0
         self.last_probe_t: Optional[float] = None
@@ -104,6 +117,7 @@ class Replica:
                 "outstanding": self.outstanding,
                 "queue_depth": self.queue_depth, "active": self.active,
                 "free_pages": self.free_pages, "inflight": self.inflight,
+                "clock_offset_s": self.clock_offset,
                 "consecutive_failures": self.fails,
                 "probes": self.probes, "last_error": self.last_error}
 
@@ -123,12 +137,18 @@ class ReplicaPool:
     def __init__(self, backends: List[str], probe_interval: float = 0.5,
                  probe_timeout: float = 2.0, dead_after: int = 3,
                  backoff_base: float = 0.5, backoff_max: float = 10.0,
-                 registry=None):
+                 registry=None, scrape_metrics: bool = False):
         if not backends:
             raise ValueError("router needs at least one backend")
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
         self.dead_after = dead_after
+        # fleet mode: each successful /health probe is followed by a
+        # GET /metrics scrape, parsed and cached on the Replica — the
+        # control plane's /fleet/metrics rollup reads the cache instead
+        # of fanning out N HTTP calls per dashboard scrape. Off for the
+        # plain router (no aggregation surface there).
+        self.scrape_metrics = scrape_metrics
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self._lock = threading.Lock()
@@ -232,6 +252,7 @@ class ReplicaPool:
         lock, network I/O outside it."""
         url = f"http://{r.host}:{r.port}/health"
         now = time.monotonic()
+        w0 = time.time()
         try:
             with urllib.request.urlopen(url,
                                         timeout=self.probe_timeout) as resp:
@@ -242,6 +263,8 @@ class ReplicaPool:
             e.close()
         except Exception as e:  # refused / timeout / reset / bad JSON
             ok, detail = None, f"{type(e).__name__}: {e}"
+        w1 = time.time()
+        scraped = self._scrape(r) if ok and self.scrape_metrics else None
         with self._lock:
             r.probes += 1
             r.last_probe_t = now
@@ -257,6 +280,16 @@ class ReplicaPool:
                 fp = detail.get("free_pages")
                 r.free_pages = int(fp) if fp is not None else None
                 r.inflight = int(detail.get("inflight_depth", 0) or 0)
+                # clock offset from the probe RTT midpoint: the replica
+                # stamped now_wall somewhere inside [w0, w1]; the
+                # midpoint is the minimum-error estimate without a
+                # second exchange (NTP's trick). Error bound ~RTT/2.
+                nw = detail.get("now_wall")
+                if nw is not None:
+                    r.clock_offset = float(nw) - (w0 + w1) / 2.0
+                if scraped is not None:
+                    r.metrics_families = scraped
+                    r.metrics_t = now
                 r.next_probe_t = now + self.probe_interval
             elif ok is False:  # wedged: degraded, normal re-probe cadence
                 r.liveness = DEGRADED
@@ -264,6 +297,28 @@ class ReplicaPool:
                 r.next_probe_t = now + self.probe_interval
             else:
                 self._fail(r, detail, now)
+
+    def _scrape(self, r: Replica):
+        """Fetch + parse one replica's /metrics (network + parse OUTSIDE
+        the pool lock). Returns parsed families or None on any failure —
+        a replica whose /metrics hiccups keeps its last good scrape."""
+        from butterfly_tpu.obs.registry import parse_exposition
+        try:
+            url = f"http://{r.host}:{r.port}/metrics"
+            with urllib.request.urlopen(url,
+                                        timeout=self.probe_timeout) as resp:
+                return parse_exposition(resp.read().decode(
+                    "utf-8", "replace"))
+        except Exception:
+            return None
+
+    def metrics_by_replica(self) -> Dict[str, dict]:
+        """Last parsed /metrics scrape per replica (fleet rollup input);
+        replicas never scraped (down, or scrape_metrics off) are absent."""
+        with self._lock:
+            return {rid: r.metrics_families
+                    for rid, r in self.replicas.items()
+                    if r.metrics_families is not None}
 
     def _fail(self, r: Replica, err: str, now: float) -> None:
         """Shared connect-failure accounting (lock held): escalate

@@ -216,10 +216,13 @@ def make_router_handler(state: RouterState):
             self._dispatch(path, body)
 
         def _dispatch(self, path: str, body: bytes,
-                      candidates=None, affinity_rid=None) -> None:
+                      candidates=None, affinity_rid=None) -> Optional[str]:
             """Plan (unless the caller — e.g. the fleet control plane's
             classifier — already planned) and walk the candidate list
-            with the single failover rule."""
+            with the single failover rule. Returns the rid of the
+            replica that produced the client's response (the fleet
+            control plane's trace records it), or None when nothing
+            could serve (an error response was sent instead)."""
             if candidates is None:
                 candidates, affinity_rid = state.policy.plan(
                     extract_route_tokens(body))
@@ -227,7 +230,7 @@ def make_router_handler(state: RouterState):
                 state.inc(state._c_unroutable)
                 self._json(503, {"error": "no live replicas"},
                            headers={"Retry-After": "1"})
-                return
+                return None
             last = ""
             for i, rep in enumerate(candidates):
                 if i > 0:
@@ -240,11 +243,12 @@ def make_router_handler(state: RouterState):
                 finally:
                     state.pool.note_done(rep.rid)
                 if result == _SENT:
-                    return
+                    return rep.rid
                 last = rep.rid
             self._json(502, {"error": "all replicas failed "
                                       f"(last tried: {last})"},
                        headers={"Retry-After": "1"})
+            return None
 
         def _attempt(self, rep: Replica, path: str, body: bytes) -> str:
             """One forwarding attempt. Returns _SENT once ANY response

@@ -121,7 +121,9 @@ class Scheduler:
     """Continuous batching over a ServingEngine."""
 
     def __init__(self, engine: ServingEngine, seed: int = 0,
-                 tracer=None, registry: Optional[MetricsRegistry] = None):
+                 tracer=None, registry: Optional[MetricsRegistry] = None,
+                 slo_ttft_s: Optional[float] = None,
+                 slo_itl_s: Optional[float] = None):
         self.engine = engine
         # Tracing is opt-in: trace=None keeps every hot-path call site a
         # single None check (obs/trace.py overhead contract). When on,
@@ -269,6 +271,32 @@ class Scheduler:
             "inflight_depth",
             "Decode blocks in flight (dispatched, not yet drained) at "
             "the end of the last scheduler tick")
+        # SLO attainment (ISSUE 7): declared objectives make latency a
+        # pass/fail measurement per request instead of a percentile to
+        # eyeball. None = no objective declared: zero accounting runs
+        # (the counters exist but never increment).
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_itl_s = slo_itl_s
+        self._c_slo_ttft_ok = reg.counter(
+            "slo_ttft_ok_total",
+            "First tokens delivered within the declared TTFT objective "
+            "(--slo-ttft-ms)")
+        self._c_slo_itl_ok = reg.counter(
+            "slo_itl_ok_total",
+            "Finished requests whose mean inter-token gap met the "
+            "declared ITL objective (--slo-itl-ms)")
+        self._c_slo_viol = reg.counter_family(
+            "slo_violations_total",
+            "Requests that missed a declared latency objective, by "
+            "objective kind", ("kind",))
+        self._g_slo_burn = reg.gauge(
+            "slo_burn_rate",
+            "Fraction of the last 256 finished requests that violated "
+            "ANY declared objective (0 = meeting SLO, 1 = burning the "
+            "whole error budget) — the rolling signal SLO-aware "
+            "admission and autoscaling read")
+        # rolling attainment window backing the burn-rate gauge
+        self._slo_window: Deque[float] = deque(maxlen=256)
         # latency reservoirs: both bounded to the same recent window so
         # the two adjacent metrics share time-horizon semantics (and a
         # long-lived server doesn't leak one float per request forever)
@@ -517,6 +545,15 @@ class Scheduler:
             m["itl_req_mean_p50"] = float(np.percentile(a, 50))
             m["itl_req_mean_p95"] = float(np.percentile(a, 95))
         m["inflight_depth"] = float(self._g_inflight.value)
+        if self.slo_ttft_s is not None or self.slo_itl_s is not None:
+            viol = sum(c.value for c in
+                       self._c_slo_viol._children.values())
+            ok = self._c_slo_ttft_ok.value + self._c_slo_itl_ok.value
+            m["slo_ttft_ok_total"] = self._c_slo_ttft_ok.value
+            m["slo_itl_ok_total"] = self._c_slo_itl_ok.value
+            m["slo_violations_total"] = viol
+            m["slo_burn_rate"] = self._g_slo_burn.value
+            m["slo_attainment"] = ok / (ok + viol) if ok + viol else 1.0
         if self._bubbles:
             # device idle per dispatched block (0 = pipeline kept the
             # device busy through the tick's host section): the number
@@ -939,6 +976,11 @@ class Scheduler:
             req.t_first_token = now
             self._ttfts.append(req.ttft)
             self._h_ttft.observe(req.ttft)
+            if self.slo_ttft_s is not None:
+                if req.ttft <= self.slo_ttft_s:
+                    self._c_slo_ttft_ok.inc()
+                else:
+                    self._c_slo_viol.labels("ttft").inc()
             if self.trace is not None:
                 self.trace.event(req.id, "first_token", ttft_s=req.ttft)
         else:
@@ -954,12 +996,32 @@ class Scheduler:
 
     def _finish(self, req: Request, state: str = "finished") -> None:
         self._epoch += 1  # batch membership changes below
+        mean_gap = None
         if state == "finished" and len(req.output) > 1 and \
                 req.t_first_token is not None:
             mean_gap = ((req.t_last_token - req.t_first_token)
                         / (len(req.output) - 1))
             self._itl_means.append(mean_gap)
             self._h_itl_mean.observe(mean_gap)
+        slo_ok = None
+        if state == "finished" and (self.slo_ttft_s is not None
+                                    or self.slo_itl_s is not None):
+            # per-request attainment: a request violates when ANY
+            # declared objective is missed (an undelivered first token
+            # counts against TTFT — the client never saw one in time)
+            viol = False
+            if self.slo_ttft_s is not None:
+                viol |= req.ttft is None or req.ttft > self.slo_ttft_s
+            if self.slo_itl_s is not None and mean_gap is not None:
+                if mean_gap <= self.slo_itl_s:
+                    self._c_slo_itl_ok.inc()
+                else:
+                    self._c_slo_viol.labels("itl").inc()
+                    viol = True
+            slo_ok = not viol
+            self._slo_window.append(0.0 if slo_ok else 1.0)
+            self._g_slo_burn.set(sum(self._slo_window)
+                                 / len(self._slo_window))
         if req.slot is not None:
             # publish the written tokens' full pages before releasing
             # (the latest sampled token's K/V is never written — it
@@ -979,10 +1041,15 @@ class Scheduler:
         if state == "finished":
             self._c_finished.inc()
         if self.trace is not None:
+            attrs = {}
+            if slo_ok is not None:
+                attrs["slo_ok"] = slo_ok
+            if mean_gap is not None:
+                attrs["itl_mean_s"] = mean_gap
             self.trace.event(req.id, "finish", state=state,
                              tokens=len(req.output),
                              preemptions=req.preemptions,
-                             ttft_s=req.ttft)
+                             ttft_s=req.ttft, **attrs)
         if req.on_finish is not None:
             req.on_finish(req)
 

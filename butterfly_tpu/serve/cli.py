@@ -137,6 +137,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "load. B buckets to powers of two clamped "
                         "here, so raising it adds at most one compiled "
                         "program per prompt-length bucket")
+    def slo_flags(sp):
+        sp.add_argument("--slo-ttft-ms", type=float, default=None,
+                        help="declared time-to-first-token objective in "
+                             "milliseconds: per-request attainment is "
+                             "recorded into the slo_ttft_ok_total / "
+                             "slo_violations_total{kind} counters and "
+                             "the rolling slo_burn_rate gauge (unset = "
+                             "no SLO accounting)")
+        sp.add_argument("--slo-itl-ms", type=float, default=None,
+                        help="declared mean inter-token-latency "
+                             "objective in milliseconds (per finished "
+                             "request, the streaming rate a client "
+                             "experiences); recorded like --slo-ttft-ms")
+
+    slo_flags(s)
     s.add_argument("--inflight-blocks", type=positive_int, default=2,
                    help="decode blocks kept in flight on the device "
                         "(dispatch-ahead): block t+1 chains on block "
@@ -209,6 +224,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "request is worth the prefill/decode handoff "
                         "(below it, requests dispatch directly to the "
                         "decode tier)")
+    slo_flags(r)  # control-plane SLO accounting for disaggregated
+    # requests (fleet_slo_* counters + burn rate; measured across the
+    # whole handoff, the latency the CLIENT experiences)
 
     # local disaggregated fleet for manual debugging: N prefill + M
     # decode in-process replicas behind one control plane, all tiny-
@@ -434,7 +452,16 @@ def cmd_route(args) -> int:
                              probe_interval=args.probe_interval,
                              dead_after=args.dead_after,
                              read_timeout=args.read_timeout,
-                             disagg_threshold=args.disagg_threshold)
+                             disagg_threshold=args.disagg_threshold,
+                             slo_ttft_s=(args.slo_ttft_ms / 1e3
+                                         if args.slo_ttft_ms else None),
+                             slo_itl_s=(args.slo_itl_ms / 1e3
+                                        if args.slo_itl_ms else None))
+    if args.slo_ttft_ms or args.slo_itl_ms:
+        print("[butterfly] note: --slo-ttft-ms/--slo-itl-ms apply to "
+              "the control plane (--disaggregate) and to the replicas' "
+              "own `serve` flags; the plain router records no SLO",
+              file=sys.stderr)
     from butterfly_tpu.router.proxy import route_forever
     return route_forever(backends, host=args.host, port=args.port,
                          page_size=args.page_size,

@@ -268,14 +268,19 @@ class ServerState:
                 raise RuntimeError("server wedged: " + self.error)
             return import_payload(self.sched, payload)
 
-    def debug_requests(self, n: Optional[int] = None) -> dict:
+    def debug_requests(self, n: Optional[int] = None,
+                       request_id: Optional[str] = None) -> dict:
         """Recent per-request trace timelines (the /debug/requests
         body). Reads only the tracer's own lock — a wedged scheduler
-        (hung tick holding self.lock) can still be inspected."""
+        (hung tick holding self.lock) can still be inspected.
+        `request_id` filters to one client id's timelines and drops the
+        global ring (the fleet trace merge wants exactly one request's
+        events, not every tick in the window)."""
         tracer = getattr(self.sched, "trace", None)
         if tracer is None:
             return {"enabled": False, "requests": []}
-        dump = tracer.dump(n_requests=n)
+        dump = tracer.dump(n_requests=n, request_id=request_id,
+                           n_global=0 if request_id is not None else None)
         dump["enabled"] = True
         return dump
 
@@ -330,7 +335,14 @@ def make_handler(state: ServerState):
                             "active": len(state.sched._all_live),
                             "free_pages": state.sched.alloc.free_pages,
                             "inflight_depth":
-                                len(state.sched._inflight)}
+                                len(state.sched._inflight),
+                            # wall-clock stamp for the prober's clock-
+                            # offset estimate (router/pool.py): the
+                            # fleet trace merge places this replica's
+                            # monotonic events on the control plane's
+                            # clock via offset = now_wall - probe RTT
+                            # midpoint
+                            "now_wall": time.time()}
                     if state.heartbeat is not None:
                         body["heartbeats"] = state.heartbeat.beats
                     self._json(200, body)
@@ -345,7 +357,8 @@ def make_handler(state: ServerState):
                 self.end_headers()
                 self.wfile.write(body)
             elif self.path.split("?")[0] == "/debug/requests":
-                self._json(200, state.debug_requests(self._query_n()))
+                n, request_id = self._query_debug()
+                self._json(200, state.debug_requests(n, request_id))
             else:
                 self._json(404, {"error": "not found"})
 
@@ -353,14 +366,18 @@ def make_handler(state: ServerState):
             rid = self.headers.get("X-Request-Id")
             return str(rid)[:128] if rid is not None else None
 
-        def _query_n(self):
-            """?n=K limit for /debug/requests; None when absent/bad."""
+        def _query_debug(self):
+            """/debug/requests query: (?n=K limit, ?request_id= client
+            id filter); (None, None) when absent/bad."""
             from urllib.parse import parse_qs, urlparse
             try:
                 qs = parse_qs(urlparse(self.path).query)
-                return int(qs["n"][0]) if "n" in qs else None
+                n = int(qs["n"][0]) if "n" in qs else None
+                rid = str(qs["request_id"][0])[:128] \
+                    if "request_id" in qs else None
+                return n, rid
             except (ValueError, TypeError, IndexError):
-                return None
+                return None, None
 
         def do_POST(self):
             self._rid = self._header_rid()
@@ -386,14 +403,24 @@ def make_handler(state: ServerState):
                                           "hex chain digests"})
                 return
             if not hashes:
-                self._json(400, {"error": "missing ?hashes= query"})
+                self._json(400, self._kv_err("missing ?hashes= query"))
                 return
             try:
                 self._json(200, state.export_kv(hashes))
             except LookupError as e:  # no prefix registry on this replica
-                self._json(501, {"error": str(e)})
+                self._json(501, self._kv_err(str(e)))
             except RuntimeError as e:  # wedged
-                self._json(503, {"error": str(e)})
+                self._json(503, self._kv_err(str(e)))
+
+        def _kv_err(self, msg: str) -> dict:
+            """KV-transfer error body: carries the request id (when the
+            control plane forwarded one) so a failed handoff leg is
+            attributable to its distributed request from logs alone —
+            the header echo alone doesn't survive into log lines."""
+            body = {"error": msg}
+            if self._rid:
+                body["request_id"] = self._rid
+            return body
 
         def _handle_kv_import(self):
             try:
@@ -404,14 +431,14 @@ def make_handler(state: ServerState):
             try:
                 self._json(200, state.import_kv(payload))
             except LookupError as e:
-                self._json(501, {"error": str(e)})
+                self._json(501, self._kv_err(str(e)))
             except (ValueError, KeyError, TypeError) as e:
                 # geometry mismatch / malformed page entries: refusing
                 # is the safety property — a mismatched import would
                 # alias garbage K/V under a valid-looking chain hash
-                self._json(409, {"error": f"{e}"})
+                self._json(409, self._kv_err(f"{e}"))
             except RuntimeError as e:  # wedged
-                self._json(503, {"error": str(e)})
+                self._json(503, self._kv_err(str(e)))
 
         def _read_body(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))
@@ -842,7 +869,14 @@ def run_server(args) -> int:
     if not getattr(args, "no_trace", False):
         from butterfly_tpu.obs.trace import Tracer
         tracer = Tracer()
-    sched = Scheduler(engine, tracer=tracer)
+    # Declared latency objectives (ms on the CLI, seconds internally):
+    # the scheduler measures per-request attainment into the slo_*
+    # counters and the rolling burn-rate gauge.
+    slo_ttft = getattr(args, "slo_ttft_ms", None)
+    slo_itl = getattr(args, "slo_itl_ms", None)
+    sched = Scheduler(engine, tracer=tracer,
+                      slo_ttft_s=slo_ttft / 1e3 if slo_ttft else None,
+                      slo_itl_s=slo_itl / 1e3 if slo_itl else None)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk
     # continuation, batched decode) before listening: the first user
     # doesn't pay 20-40s of XLA compile, and the heartbeat watchdog

@@ -129,6 +129,12 @@ SLOW_TESTS = {
     "test_string_prompt_routes_direct",
     "test_handoff_falls_back_when_prefill_tier_dies",
     "test_fleet_soak_rolling_drain_restart",
+    # fleet observability scenarios on the same in-process topologies
+    # (the fast tier keeps the pure-host pieces: trace merging,
+    # exposition parse/sum, trace_report --fleet smoke in test_obs.py)
+    "test_fleet_trace_merged_waterfall",
+    "test_fleet_trace_direct_request_and_unknown_id",
+    "test_fleet_metrics_rollup_sums_match_replicas",
 }
 
 

@@ -14,7 +14,11 @@ Layered like the subsystem:
   acceptance contract), fallback when a tier dies mid-handoff;
 * the fleet soak — 2 prefill + 2 decode replicas through a rolling
   drain/restart cycle with zero dropped un-started requests and a
-  positive transfer hit rate.
+  positive transfer hit rate;
+* the observability plane (ISSUE 7) — /fleet/trace merged waterfalls
+  (leg ordering, common clock, missing-replica degradation),
+  /fleet/metrics rollup sums vs per-replica /metrics, and SLO
+  attainment through the soak.
 
 Everything runs in-process on the tiny model (the test_router.py
 idiom); the multi-replica pieces are slow-marked in conftest.py.
@@ -220,8 +224,12 @@ def test_import_idempotent(shared_model):
 def fleet_1p1d(shared_model):
     from butterfly_tpu.fleet.harness import start_fleet
     model, params = shared_model
+    # generous CPU-smoke objectives: the SLO layer records attainment
+    # (fleet_slo_* counters, slo_ttft_ok response fields) without ever
+    # turning a slow CI box into a flake
     fleet = start_fleet("1p1d", page_size=PAGE, max_batch=2, max_seq=128,
-                        disagg_threshold=16, model=model, params=params)
+                        disagg_threshold=16, model=model, params=params,
+                        slo_ttft_s=120.0, slo_itl_s=120.0)
     yield fleet
     fleet.stop()
 
@@ -346,15 +354,134 @@ def test_handoff_falls_back_when_prefill_tier_dies(shared_model):
         pre.httpd.server_close()
         prompt = list(range(7, 47))
         r = post(fleet.url, "/generate",
-                 {"tokens": prompt, "max_tokens": 4, "stop_token": -1})
+                 {"tokens": prompt, "max_tokens": 4, "stop_token": -1,
+                  "request_id": "fb-1"})
         assert "disaggregated" not in r and len(r["tokens"]) == 4
         assert fleet.state.fleet_counters()["disagg_fallbacks"] >= 1
         ref = make_sched(shared_model)
         rr = ref.submit(prompt, max_new_tokens=4, stop_token=-1)
         ref.run_until_done()
         assert r["tokens"] == rr.output
+        # the trace still assembles: the dead prefill replica's leg
+        # degrades to control-plane spans only, the fallback event and
+        # the direct leg that actually served are both recorded
+        tr = get(fleet.url, "/fleet/trace?request_id=fb-1")
+        names = [ev["name"] for ev in tr["merged"]
+                 if ev["source"] == "control"]
+        assert "fallback" in names and "direct_leg" in names
+        assert tr["sources"][pre.rid].get("missing") is True
+        dec_rid = fleet.replicas[1].rid
+        assert tr["sources"][dec_rid]["events"] > 0
     finally:
         fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet observability: merged traces, metrics rollup, SLO (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def test_fleet_trace_merged_waterfall(fleet_1p1d):
+    """The acceptance trace: one disaggregated request yields ONE
+    /fleet/trace timeline — control-plane legs (classify → prefill_leg
+    → kv transfer → decode_leg) interleaved with BOTH replicas' span
+    events on a common clock, leg durations summing to within 10% of
+    the measured end-to-end latency, and SLO verdicts attached."""
+    pre, dec = fleet_1p1d.replicas
+    prompt = list(range(2, 42))  # 5 full pages
+    r = post(fleet_1p1d.url, "/generate",
+             {"tokens": prompt, "max_tokens": 8, "stop_token": -1,
+              "request_id": "trace-e2e-1"})
+    assert r["disaggregated"] and r["request_id"] == "trace-e2e-1"
+    assert r["slo_ttft_ok"] is True and r["slo_itl_ok"] is True
+
+    tr = get(fleet_1p1d.url, "/fleet/trace?request_id=trace-e2e-1")
+    names = [leg["name"] for leg in tr["legs"]]
+    assert names == ["classify", "prefill_leg", "kv_export",
+                     "kv_import", "decode_leg"]
+    # per-leg durations account for the end-to-end latency (10% slack)
+    assert tr["total_s"] == pytest.approx(r["total_s"], rel=0.2)
+    assert abs(tr["legs_total_s"] - tr["total_s"]) \
+        < 0.1 * tr["total_s"]
+    # control-plane leg spans are ordered and non-overlapping
+    for a, b in zip(tr["legs"], tr["legs"][1:]):
+        assert b["start_wall"] >= a["end_wall"] - 1e-4
+    # all three processes contribute, merged on one clock
+    srcs = {ev["source"] for ev in tr["merged"]}
+    assert srcs == {"control", pre.rid, dec.rid}
+    ts = [ev["t_wall"] for ev in tr["merged"]]
+    assert ts == sorted(ts)
+    # within each replica the span events stay in recorded order
+    for rid in (pre.rid, dec.rid):
+        mine = [ev for ev in tr["merged"] if ev["source"] == rid]
+        assert mine and [ev["t_wall"] for ev in mine] == \
+            sorted(ev["t_wall"] for ev in mine)
+    # the prefill replica's own first_token lands inside the
+    # prefill leg's wall-clock span (clock-offset sanity, loopback)
+    leg = tr["legs"][1]
+    ft = next(ev for ev in tr["merged"]
+              if ev["source"] == pre.rid and ev["name"] == "first_token")
+    assert leg["start_wall"] - 0.05 <= ft["t_wall"] \
+        <= leg["end_wall"] + 0.05
+    assert tr["slo"]["slo_ttft_ok"] is True
+
+
+def test_fleet_trace_direct_request_and_unknown_id(fleet_1p1d):
+    """Direct dispatches trace too (classify + direct_leg), and an
+    unknown request id is a clean 404, not a 500."""
+    post(fleet_1p1d.url, "/generate",
+         {"tokens": [5, 6, 7], "max_tokens": 2, "stop_token": -1,
+          "request_id": "trace-direct-1"})
+    tr = get(fleet_1p1d.url, "/fleet/trace?request_id=trace-direct-1")
+    names = [leg["name"] for leg in tr["legs"]]
+    assert names[0] == "classify" and "direct_leg" in names
+    direct = next(leg for leg in tr["legs"]
+                  if leg["name"] == "direct_leg")
+    assert direct["replica"] in {r.rid for r in fleet_1p1d.replicas}
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(fleet_1p1d.url, "/fleet/trace?request_id=never-seen")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(fleet_1p1d.url, "/fleet/trace")
+    assert e.value.code == 400
+
+
+def test_fleet_metrics_rollup_sums_match_replicas(fleet_1p1d):
+    """/fleet/metrics counter sums equal the per-replica sums, the
+    re-bucketed histograms stay internally consistent (+Inf == _count),
+    and the per-replica autoscale gauges are exposed labeled."""
+    from butterfly_tpu.obs.registry import parse_exposition
+    post(fleet_1p1d.url, "/generate",
+         {"tokens": list(range(1, 30)), "max_tokens": 4,
+          "stop_token": -1})
+    fleet_1p1d.state.pool.probe_all()  # fresh synchronous scrape round
+    with urllib.request.urlopen(fleet_1p1d.url + "/fleet/metrics",
+                                timeout=30) as resp:
+        text = resp.read().decode()
+    fams = parse_exposition(text)
+    # counters: fleet sum == sum over replicas' own /metrics
+    per_replica = 0.0
+    for rep in fleet_1p1d.replicas:
+        with urllib.request.urlopen(rep.url + "/metrics",
+                                    timeout=30) as resp:
+            rf = parse_exposition(resp.read().decode())
+        per_replica += rf["butterfly_requests_total"]["samples"][
+            ("butterfly_requests_total", ())]
+    agg = fams["butterfly_fleet_requests_total"]["samples"][
+        ("butterfly_fleet_requests_total", ())]
+    assert agg == per_replica > 0
+    # histograms: re-bucketed exactly, +Inf bucket == _count
+    h = fams["butterfly_fleet_ttft_seconds"]["samples"]
+    inf = h[("butterfly_fleet_ttft_seconds_bucket", (("le", "+Inf"),))]
+    assert inf == h[("butterfly_fleet_ttft_seconds_count", ())] > 0
+    # per-replica autoscale gauges, one series per replica
+    fp = fams["butterfly_fleet_replica_kv_pages_free"]["samples"]
+    assert len(fp) == len(fleet_1p1d.replicas)
+    assert fams["butterfly_fleet_replicas_scraped"]["samples"][
+        ("butterfly_fleet_replicas_scraped", ())] == 2.0
+    # clock offsets learned from the same probe loop (loopback: ~0)
+    for snap in fleet_1p1d.state.pool.snapshot():
+        assert snap["clock_offset_s"] is not None
+        assert abs(snap["clock_offset_s"]) < 5.0
 
 
 # ---------------------------------------------------------------------------
@@ -412,7 +539,8 @@ def test_fleet_soak_rolling_drain_restart(shared_model):
             fleet.url, clients=3, requests_per_client=3,
             prefix_share=0.5, shared_len=4 * PAGE, tail_len=4,
             max_tokens=4, replicas=fleet.rids,
-            restart_hook=lambda rid: fleet.by_rid[rid].restart())
+            restart_hook=lambda rid: fleet.by_rid[rid].restart(),
+            slo_ttft_ms=120_000.0, slo_itl_ms=120_000.0)
         assert stats["failed"] == 0, stats["errors"]
         assert stats["ok"] == 9
         assert len(stats["rolling_cycles"]) == 4
@@ -422,8 +550,18 @@ def test_fleet_soak_rolling_drain_restart(shared_model):
         assert fm["kv_transfer_hit_rate"] > 0
         assert fm["kv_transfer_bytes"] > 0
         assert stats["disaggregated"] > 0
+        # client-side SLO attainment against the declared (generous)
+        # objectives rides the soak summary
+        assert stats["slo_attainment"] == 1.0
+        assert stats["slo_ttft_ok"] == stats["ok"]
         # every replica answers again after its restart
         for r in fleet.replicas:
             assert get(r.url, "/health")["status"] == "ok"
+        # trace assembly SURVIVED the rolling restarts: every loadgen
+        # request id still yields at least its control-plane spans
+        # (replica fronts bounced mid-soak; schedulers+tracers live on)
+        tr = get(fleet.url, "/fleet/trace?request_id=loadgen-0-0")
+        assert any(ev["source"] == "control" for ev in tr["merged"])
+        assert [l["name"] for l in tr["legs"]][0] == "classify"
     finally:
         fleet.stop()

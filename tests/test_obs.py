@@ -15,8 +15,9 @@ import pytest
 from butterfly_tpu.obs.metrics import render_prometheus
 from butterfly_tpu.obs.registry import (
     LATENCY_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
-    sanitize_name)
-from butterfly_tpu.obs.trace import Tracer, summarize_timeline
+    parse_exposition, render_parsed, sanitize_name, sum_expositions)
+from butterfly_tpu.obs.trace import (
+    Tracer, merge_fleet_trace, summarize_timeline)
 
 REPO = Path(__file__).parent.parent
 
@@ -194,6 +195,168 @@ def test_tracer_dump_is_json_serializable():
     assert back["global_events"][0]["name"] == "decode_tick"
 
 
+# -- exposition parsing + fleet aggregation ---------------------------------
+
+def _registry_with(n, ladder=(0.1, 1.0)):
+    reg = MetricsRegistry()
+    reg.counter("requests_total", "Requests").inc(n)
+    h = reg.histogram("ttft_seconds", "ttft", buckets=ladder)
+    h.observe(0.05)
+    h.observe(0.5)
+    reg.gauge("queue_depth", "q").set(n)
+    reg.counter_family("router_requests_total", "by",
+                       ("replica",)).labels(f"r{n}").inc(n)
+    return reg
+
+
+def test_parse_exposition_roundtrip():
+    fams = parse_exposition(_registry_with(3).render())
+    assert fams["butterfly_requests_total"]["type"] == "counter"
+    assert fams["butterfly_requests_total"]["samples"][
+        ("butterfly_requests_total", ())] == 3.0
+    # histogram series fold under the family name
+    h = fams["butterfly_ttft_seconds"]
+    assert h["type"] == "histogram"
+    assert h["samples"][("butterfly_ttft_seconds_count", ())] == 2.0
+    assert h["samples"][
+        ("butterfly_ttft_seconds_bucket", (("le", "0.1"),))] == 1.0
+    # labeled family samples keep their labels
+    assert fams["butterfly_router_requests_total"]["samples"][
+        ("butterfly_router_requests_total", (("replica", "r3"),))] == 3.0
+    # garbage lines are skipped, not fatal
+    assert parse_exposition("not a metric line\n# weird\n") == {}
+
+
+def test_sum_expositions_counters_and_histograms_exact():
+    parsed = [parse_exposition(_registry_with(n).render())
+              for n in (3, 5)]
+    agg = sum_expositions(parsed)
+    assert agg["butterfly_requests_total"]["samples"][
+        ("butterfly_requests_total", ())] == 8.0
+    h = agg["butterfly_ttft_seconds"]["samples"]
+    # cumulative bucket sums stay cumulative and +Inf == _count
+    assert h[("butterfly_ttft_seconds_bucket", (("le", "0.1"),))] == 2.0
+    assert h[("butterfly_ttft_seconds_bucket", (("le", "+Inf"),))] == 4.0
+    assert h[("butterfly_ttft_seconds_count", ())] == 4.0
+    # gauges never aggregate by summation
+    assert "butterfly_queue_depth" not in agg
+    # distinct label children survive as distinct series
+    fam = agg["butterfly_router_requests_total"]["samples"]
+    assert fam[("butterfly_router_requests_total",
+                (("replica", "r3"),))] == 3.0
+    assert fam[("butterfly_router_requests_total",
+                (("replica", "r5"),))] == 5.0
+
+
+def test_sum_expositions_drops_mismatched_ladders():
+    a = parse_exposition(_registry_with(1, ladder=(0.1, 1.0)).render())
+    b = parse_exposition(_registry_with(1, ladder=(0.2, 2.0)).render())
+    agg = sum_expositions([a, b])
+    # a partial bucket sum would render +Inf != _count: drop the family
+    assert "butterfly_ttft_seconds" not in agg
+    assert agg["butterfly_requests_total"]["samples"][
+        ("butterfly_requests_total", ())] == 2.0
+
+
+def test_render_parsed_renames_namespaced():
+    agg = sum_expositions(
+        [parse_exposition(_registry_with(2).render())])
+    text = "\n".join(render_parsed(
+        agg, rename=lambda n: n.replace("butterfly_",
+                                        "butterfly_fleet_", 1)))
+    assert "butterfly_fleet_requests_total 2" in text
+    assert 'butterfly_fleet_ttft_seconds_bucket{le="+Inf"} 2' in text
+    assert 'butterfly_fleet_router_requests_total{replica="r2"} 2' in text
+    # every sample line is still a legal prometheus series
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$",
+                        line), line
+
+
+# -- fleet trace merging ------------------------------------------------------
+
+def test_tracer_request_id_filter_and_lookup():
+    tr = Tracer()
+    tr.begin_request(0, request_id="a")
+    tr.begin_request(1, request_id="b")
+    tr.begin_request(2, request_id="a")  # retry of the same client id
+    assert [t["id"] for t in tr.timelines(request_id="a")] == [0, 2]
+    assert tr.find_by_request_id("a")["id"] == 2  # newest wins
+    assert tr.find_by_request_id("zzz") is None
+    dump = tr.dump(request_id="b", n_global=0)
+    assert [t["id"] for t in dump["requests"]] == [1]
+    assert dump["global_events"] == []
+
+
+def _fleet_tracers():
+    """A synthetic control plane + one replica tracing the same id.
+    Leg events are recorded at leg END carrying dur_s, like the real
+    FleetHandler — the sleeps make the ends (and therefore the derived
+    start_wall ordering) physically real."""
+    import time
+    cp = Tracer()
+    cp.begin_request(0, request_id="rq", path="/generate")
+    time.sleep(0.002)
+    cp.event(0, "classify", dur_s=0.001, decision="disagg")
+    rep = Tracer()
+    rep.begin_request(7, request_id="rq")
+    rep.event(7, "first_token", ttft_s=0.002)
+    rep.event(7, "finish", state="finished", tokens=1)
+    time.sleep(0.012)
+    cp.event(0, "prefill_leg", dur_s=0.01, replica="a:1", status="ok")
+    time.sleep(0.021)
+    cp.event(0, "decode_leg", dur_s=0.02, replica="b:1", status="ok")
+    cp.event(0, "finish", state="disaggregated", tokens=8, total_s=0.033,
+             ttft_s=0.012, slo_ttft_ok=True)
+    return cp, rep
+
+
+def test_merge_fleet_trace_common_clock_and_offset():
+    cp, rep = _fleet_tracers()
+    control = {"timeline": cp.timeline(0), "t0_wall": cp.t0_wall,
+               "t0_monotonic": cp.t0_monotonic}
+    merged = merge_fleet_trace("rq", control, {
+        "a:1": {"dump": rep.dump(request_id="rq"), "offset_s": 0.25}})
+    # every event lands on one clock, time-sorted
+    ts = [ev["t_wall"] for ev in merged["merged"]]
+    assert ts == sorted(ts)
+    assert {ev["source"] for ev in merged["merged"]} == {"control", "a:1"}
+    # the replica's events shifted EARLIER by its +250ms clock offset
+    zero = merge_fleet_trace("rq", control, {
+        "a:1": {"dump": rep.dump(request_id="rq"), "offset_s": 0.0}})
+    t_off = [e["t_wall"] for e in merged["merged"]
+             if e["source"] == "a:1"]
+    t_zero = [e["t_wall"] for e in zero["merged"]
+              if e["source"] == "a:1"]
+    assert all(abs((z - o) - 0.25) < 1e-9
+               for z, o in zip(t_zero, t_off))
+    # legs come from the control-plane dur_s spans, waterfall-ordered
+    assert [leg["name"] for leg in merged["legs"]] == \
+        ["classify", "prefill_leg", "decode_leg"]
+    assert merged["legs_total_s"] == pytest.approx(0.031)
+    assert merged["total_s"] == pytest.approx(0.033)
+    assert merged["slo"]["slo_ttft_ok"] is True
+    json.dumps(merged)  # the /fleet/trace body must be JSON-ready
+
+
+def test_merge_fleet_trace_missing_replica_degrades():
+    cp, _ = _fleet_tracers()
+    control = {"timeline": cp.timeline(0), "t0_wall": cp.t0_wall,
+               "t0_monotonic": cp.t0_monotonic}
+    merged = merge_fleet_trace("rq", control, {
+        "a:1": {"dump": None, "offset_s": None, "error": "refused"},
+        "b:1": {"dump": {"requests": [], "t0_wall": 0.0,
+                         "t0_monotonic": 0.0}, "offset_s": 0.0}})
+    # control-plane spans survive alone; both replicas marked missing
+    assert {ev["source"] for ev in merged["merged"]} == {"control"}
+    assert merged["sources"]["a:1"]["missing"] is True
+    assert merged["sources"]["a:1"]["error"] == "refused"
+    assert merged["sources"]["b:1"]["missing"] is True
+    assert len(merged["legs"]) == 3
+
+
 # -- tools/trace_report.py smoke --------------------------------------------
 
 def _synthetic_dump(path):
@@ -261,3 +424,33 @@ def test_trace_report_cli_smoke(tmp_path):
          str(tmp_path / "nope.json")],
         capture_output=True, text=True, timeout=60)
     assert out3.returncode == 2 and "error:" in out3.stderr
+
+
+def test_trace_report_fleet_cli_smoke(tmp_path):
+    """--fleet renders a dumped merged trace (the GET /fleet/trace
+    body) as a real subprocess — stdlib-only, no jax import — so
+    report-rendering regressions fail tier-1."""
+    cp, rep = _fleet_tracers()
+    merged = merge_fleet_trace(
+        "rq", {"timeline": cp.timeline(0), "t0_wall": cp.t0_wall,
+               "t0_monotonic": cp.t0_monotonic},
+        {"a:1": {"dump": rep.dump(request_id="rq"), "offset_s": 0.0},
+         "b:1": {"dump": None, "offset_s": None, "error": "refused"}})
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps(merged))
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         "--fleet", str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for needle in ("request_id=rq", "prefill_leg", "decode_leg",
+                   "legs sum", "MISSING", "slo:"):
+        assert needle in out.stdout, (needle, out.stdout)
+    # a per-request dump is not a fleet dump: loud error, exit 2
+    plain = tmp_path / "plain.json"
+    _synthetic_dump(plain)
+    out2 = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         "--fleet", str(plain)],
+        capture_output=True, text=True, timeout=60)
+    assert out2.returncode == 2 and "merged" in out2.stderr

@@ -109,6 +109,48 @@ def test_metrics_surface():
     assert m["kv_pages_free"] == m["kv_pages_total"]
 
 
+def test_slo_attainment_counters_and_burn_rate():
+    """Declared objectives turn latency into pass/fail counters: a
+    generous SLO attains everything (burn 0), an impossible one
+    violates everything (burn 1), and the trace finish event carries
+    the per-request verdict."""
+    from butterfly_tpu.obs.trace import Tracer
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+    engine = ServingEngine(model, params, rt)
+    ok = Scheduler(engine, tracer=Tracer(), slo_ttft_s=1e6, slo_itl_s=1e6)
+    r = ok.submit([5, 7, 11], max_new_tokens=4)
+    ok.run_until_done()
+    m = ok.metrics()
+    assert m["slo_ttft_ok_total"] == 1 and m["slo_itl_ok_total"] == 1
+    assert m["slo_violations_total"] == 0
+    assert m["slo_burn_rate"] == 0.0 and m["slo_attainment"] == 1.0
+    fin = [e for e in ok.trace.timeline(r.id)["events"]
+           if e["name"] == "finish"][0]
+    assert fin["slo_ok"] is True and fin["itl_mean_s"] >= 0
+    # the typed registry renders the counters + burn gauge on /metrics
+    text = ok.registry.render()
+    assert "butterfly_slo_ttft_ok_total 1" in text
+    assert "butterfly_slo_burn_rate 0" in text
+
+    bad = Scheduler(engine, slo_ttft_s=1e-12, slo_itl_s=1e-12)
+    bad.submit([5, 7, 11], max_new_tokens=4)
+    bad.run_until_done()
+    m = bad.metrics()
+    assert m["slo_ttft_ok_total"] == 0
+    assert m["slo_violations_total"] == 2  # ttft AND itl missed
+    assert m["slo_burn_rate"] == 1.0 and m["slo_attainment"] == 0.0
+    assert 'butterfly_slo_violations_total{kind="ttft"} 1' \
+        in bad.registry.render()
+
+    # no objective declared -> no accounting, no metrics keys
+    off = Scheduler(engine)
+    off.submit([5], max_new_tokens=2)
+    off.run_until_done()
+    assert "slo_burn_rate" not in off.metrics()
+
+
 def test_streaming_callback_order():
     sched, _ = make_sched()
     seen = []

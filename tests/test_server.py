@@ -187,6 +187,29 @@ def test_debug_requests_header_id_passthrough(server):
     assert any(r["request_id"] == "hdr-77" for r in body["requests"])
 
 
+def test_debug_requests_request_id_filter(server):
+    """?request_id= narrows the dump to ONE distributed request's
+    timelines (the fleet trace-merge fetch), drops the global ring, and
+    still carries the wall-clock anchors offline tools align on."""
+    for rid in ("filt-a", "filt-b"):
+        post(server, "/generate", {"tokens": [3, 5], "max_tokens": 2,
+                                   "stop_token": -1, "request_id": rid})
+    body = json.loads(get(server, "/debug/requests?request_id=filt-a"))
+    assert body["enabled"] is True
+    assert [r["request_id"] for r in body["requests"]] == ["filt-a"]
+    assert body["global_events"] == []  # one request's view, no ticks
+    assert body["t0_wall"] > 0 and body["t0_monotonic"] >= 0
+    missing = json.loads(get(server, "/debug/requests?request_id=nope"))
+    assert missing["requests"] == []
+
+
+def test_health_carries_wall_clock(server):
+    """/health stamps now_wall — the prober's clock-offset input."""
+    import time
+    body = json.loads(get(server, "/health"))
+    assert abs(body["now_wall"] - time.time()) < 60
+
+
 def test_validation_errors(server):
     for body, code in [({"prompt": ""}, 400),
                        ({"tokens": [999999]}, 400),

@@ -21,6 +21,12 @@ disaggregated control plane the result also carries the
 /fleet/state transfer counters (kv_transfer_hit_rate, bytes, the
 disagg/direct split) and client-observed TTFT percentiles.
 
+``--slo-ttft-ms`` / ``--slo-itl-ms`` declare latency objectives: every
+request is judged client-side (TTFT and per-request mean ITL from the
+response body) and the summary reports ``slo_attainment`` — the
+fraction of successful requests that met every declared objective,
+the client-observed twin of the servers' slo_* counters.
+
 Importable by tests (``run_load`` / ``run_fleet_soak``) and runnable
 standalone:
 
@@ -60,13 +66,22 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
              prefix_share: float = 0.5, shared_len: int = 32,
              tail_len: int = 8, max_tokens: int = 8, seed: int = 0,
              vocab: int = 64, path: str = "/generate",
-             timeout: float = 120.0) -> Dict:
+             timeout: float = 120.0,
+             slo_ttft_ms: Optional[float] = None,
+             slo_itl_ms: Optional[float] = None) -> Dict:
     """Drive `url` closed-loop; returns aggregate stats.
 
     Every request uses token-id prompts (deterministic, tokenizer-free).
     A `prefix_share` fraction starts with the shared prefix plus a
     per-request tail; the rest are fully private prompts of the same
     total length, so the two populations differ only in shareability.
+
+    With declared objectives (`slo_ttft_ms` / `slo_itl_ms`) every
+    request is judged CLIENT-SIDE against them — TTFT from the body's
+    `ttft_s`, mean ITL from `(total_s - ttft_s)/(tokens - 1)` — and the
+    summary carries `slo_attainment`, the fraction of OK responses that
+    met every declared objective (a response missing the fields it
+    needs counts as a miss: the client couldn't verify its SLO).
     """
     prefix = shared_prefix(shared_len, seed, vocab)
     lock = threading.Lock()
@@ -75,7 +90,9 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     shared_latencies: List[float] = []
     by_replica: Dict[str, int] = {}
     errors: List[str] = []
-    counts = {"sent": 0, "ok": 0, "shared": 0, "disaggregated": 0}
+    counts = {"sent": 0, "ok": 0, "shared": 0, "disaggregated": 0,
+              "slo_ok": 0, "slo_ttft_ok": 0, "slo_itl_ok": 0}
+    slo_declared = slo_ttft_ms is not None or slo_itl_ms is not None
 
     def one_client(cid: int) -> None:
         rng = random.Random(seed * 1000 + cid)
@@ -104,13 +121,32 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
                     obj = json.loads(raw or b"{}")
                     ttft = obj.get("ttft_s")
                     disagg = bool(obj.get("disaggregated"))
+                    n_toks = len(obj.get("tokens") or ())
+                    total = obj.get("total_s")
                 except (ValueError, AttributeError):
-                    ttft, disagg = None, False
+                    ttft, disagg, n_toks, total = None, False, 0, None
+                # client-side SLO verdicts for this request
+                ttft_ok = itl_ok = True
+                if slo_ttft_ms is not None:
+                    ttft_ok = isinstance(ttft, (int, float)) \
+                        and ttft * 1e3 <= slo_ttft_ms
+                if slo_itl_ms is not None and n_toks > 1 \
+                        and isinstance(ttft, (int, float)) \
+                        and isinstance(total, (int, float)):
+                    itl_ok = ((total - ttft) / (n_toks - 1)
+                              * 1e3 <= slo_itl_ms)
+                elif slo_itl_ms is not None and (
+                        not isinstance(total, (int, float))):
+                    itl_ok = False
                 with lock:
                     counts["sent"] += 1
                     counts["ok"] += 1
                     counts["shared"] += int(is_shared)
                     counts["disaggregated"] += int(disagg)
+                    if slo_declared:
+                        counts["slo_ttft_ok"] += int(ttft_ok)
+                        counts["slo_itl_ok"] += int(itl_ok)
+                        counts["slo_ok"] += int(ttft_ok and itl_ok)
                     latencies.append(dt)
                     if isinstance(ttft, (int, float)):
                         ttfts.append(float(ttft))
@@ -145,6 +181,12 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
         "shared_latency_p50_s": _percentile(shared_latencies, 50),
         "by_replica": by_replica,
         "errors": errors[:20],
+        "slo_ttft_ms": slo_ttft_ms,
+        "slo_itl_ms": slo_itl_ms,
+        "slo_attainment": (counts["slo_ok"] / counts["ok"]
+                           if slo_declared and counts["ok"] else None),
+        "slo_ttft_ok": counts["slo_ttft_ok"] if slo_declared else None,
+        "slo_itl_ok": counts["slo_itl_ok"] if slo_declared else None,
     }
 
 
@@ -181,7 +223,9 @@ def run_fleet_soak(url: str, clients: int = 4,
                    tail_len: int = 8, max_tokens: int = 8, seed: int = 0,
                    vocab: int = 64, timeout: float = 120.0,
                    replicas: Optional[List[str]] = None,
-                   restart_hook=None, settle_s: float = 0.3) -> Dict:
+                   restart_hook=None, settle_s: float = 0.3,
+                   slo_ttft_ms: Optional[float] = None,
+                   slo_itl_ms: Optional[float] = None) -> Dict:
     """Fleet soak: closed-loop load against a control plane WHILE every
     replica is rolled through drain -> (restart) -> undrain, one at a
     time. The pass/fail property is the router tier's: zero dropped
@@ -206,7 +250,8 @@ def run_fleet_soak(url: str, clients: int = 4,
             url, clients=clients, requests_per_client=requests_per_client,
             prefix_share=prefix_share, shared_len=shared_len,
             tail_len=tail_len, max_tokens=max_tokens, seed=seed,
-            vocab=vocab, timeout=timeout))
+            vocab=vocab, timeout=timeout, slo_ttft_ms=slo_ttft_ms,
+            slo_itl_ms=slo_itl_ms))
 
     t = threading.Thread(target=_load)
     t.start()
@@ -248,6 +293,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--max-tokens", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--path", default="/generate")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    help="declared TTFT objective: judge every request "
+                         "client-side and report slo_attainment")
+    ap.add_argument("--slo-itl-ms", type=float, default=None,
+                    help="declared mean inter-token-latency objective "
+                         "(per request), judged client-side")
     ap.add_argument("--soak", action="store_true",
                     help="fleet soak mode: roll every replica through "
                          "drain/undrain (discovered via "
@@ -263,14 +314,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                                prefix_share=args.prefix_share,
                                shared_len=args.shared_len,
                                tail_len=args.tail_len,
-                               max_tokens=args.max_tokens, seed=args.seed)
+                               max_tokens=args.max_tokens, seed=args.seed,
+                               slo_ttft_ms=args.slo_ttft_ms,
+                               slo_itl_ms=args.slo_itl_ms)
     else:
         stats = run_load(args.url, clients=args.clients,
                          requests_per_client=args.requests,
                          prefix_share=args.prefix_share,
                          shared_len=args.shared_len, tail_len=args.tail_len,
                          max_tokens=args.max_tokens, seed=args.seed,
-                         path=args.path)
+                         path=args.path, slo_ttft_ms=args.slo_ttft_ms,
+                         slo_itl_ms=args.slo_itl_ms)
     if args.json:
         print(json.dumps(stats, indent=2))
     else:
@@ -278,6 +332,10 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"failed={stats['failed']} rps={stats['rps']:.2f}")
         print(f"latency p50={stats['latency_p50_s'] * 1e3:.1f}ms "
               f"p95={stats['latency_p95_s'] * 1e3:.1f}ms")
+        if stats.get("slo_attainment") is not None:
+            print(f"slo attainment={stats['slo_attainment']:.3f} "
+                  f"(ttft_ok={stats['slo_ttft_ok']}/{stats['ok']}, "
+                  f"itl_ok={stats['slo_itl_ok']}/{stats['ok']})")
         if stats["by_replica"]:
             print("by replica: " + ", ".join(
                 f"{rid}={n}" for rid, n in

@@ -11,6 +11,15 @@ did this request's time go" view.
     python tools/trace_report.py trace.json
     python tools/trace_report.py trace.json --timeline 17
 
+``--fleet`` renders a MERGED cross-replica trace instead — the JSON a
+fleet control plane returns from ``GET /fleet/trace?request_id=``: the
+control-plane leg waterfall (classify → prefill_leg → kv transfer →
+decode_leg), every involved replica's span events interleaved on the
+control plane's clock, per-leg durations, and the SLO verdicts.
+
+    curl -s "localhost:8100/fleet/trace?request_id=abc" > fleet.json
+    python tools/trace_report.py --fleet fleet.json
+
 stdlib-only on purpose: runs anywhere the dump lands (laptop, CI), no
 jax / no backend required.
 """
@@ -122,18 +131,96 @@ def render_timeline(dump: Dict[str, Any], rid: int) -> str:
     return "\n".join(out)
 
 
+def load_fleet_dump(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or "merged" not in dump:
+        raise ValueError(
+            f"{path}: not a merged fleet trace (expected a JSON object "
+            f"with a 'merged' key — the GET /fleet/trace?request_id= "
+            f"body)")
+    return dump
+
+
+def render_fleet(dump: Dict[str, Any]) -> str:
+    """The cross-replica waterfall: control-plane legs with durations,
+    then every source's events interleaved on the common clock."""
+    out = [f"fleet trace request_id={dump.get('request_id')}"]
+    t0 = dump.get("t0_wall") or 0.0
+    legs = dump.get("legs", [])
+    if legs:
+        out.append("legs (control plane):")
+        for leg in legs:
+            where = leg.get("replica") or "-"
+            status = leg.get("status", "")
+            out.append(f"  +{leg['start_wall'] - t0:9.4f}s "
+                       f"{leg['name']:<12} {_fmt_s(leg['dur_s']):>9}  "
+                       f"{where}{('  [' + status + ']') if status and status != 'ok' else ''}")
+        total, legsum = dump.get("total_s"), dump.get("legs_total_s")
+        if total:
+            out.append(f"  legs sum {_fmt_s(legsum)} of "
+                       f"{_fmt_s(total)} end-to-end "
+                       f"({legsum / total * 100:.1f}% accounted)")
+    out.append("merged timeline:")
+    width = max((len(ev.get("source", "")) for ev in dump["merged"]),
+                default=7)
+    prev = t0
+    for ev in dump["merged"]:
+        t = ev["t_wall"]
+        attrs = " ".join(f"{k}={v}" for k, v in ev.items()
+                         if k not in ("t", "t_wall", "name", "source",
+                                      "replica_req"))
+        out.append(f"  +{t - t0:9.4f}s (Δ{_fmt_s(max(0.0, t - prev)):>7}) "
+                   f"[{ev.get('source', ''):<{width}}] "
+                   f"{ev['name']:<14} {attrs}")
+        prev = t
+    srcs = dump.get("sources", {})
+    if srcs:
+        parts = []
+        for name, info in srcs.items():
+            if info.get("missing"):
+                parts.append(f"{name}: MISSING ({info.get('error', '?')})")
+            else:
+                off = info.get("offset_s")
+                parts.append(f"{name}: {info.get('events', 0)} event(s)"
+                             + (f", clock offset {off * 1e3:+.1f}ms"
+                                if off else ""))
+        out.append("sources: " + "; ".join(parts))
+    slo = dump.get("slo")
+    if slo:
+        verdicts = []
+        if "slo_ttft_ok" in slo:
+            verdicts.append(
+                f"ttft {_fmt_s(slo.get('ttft_s'))} -> "
+                f"{'OK' if slo['slo_ttft_ok'] else 'VIOLATED'}")
+        if "slo_itl_ok" in slo:
+            verdicts.append(
+                f"itl_mean {_fmt_s(slo.get('itl_mean_s'))} -> "
+                f"{'OK' if slo['slo_itl_ok'] else 'VIOLATED'}")
+        out.append("slo: " + ("; ".join(verdicts) if verdicts
+                              else "no objectives declared"))
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="trace_report",
-        description="summarize a /debug/requests trace dump")
+        description="summarize a /debug/requests trace dump (or, with "
+                    "--fleet, a merged /fleet/trace dump)")
     p.add_argument("dump", help="path to the JSON trace dump")
     p.add_argument("--timeline", type=int, default=None, metavar="ID",
                    help="print one request's full event timeline")
+    p.add_argument("--fleet", action="store_true",
+                   help="render a merged cross-replica fleet trace "
+                        "(the GET /fleet/trace?request_id= body)")
     p.add_argument("--json", action="store_true",
                    help="emit the per-request summaries as JSON instead "
                         "of a table")
     args = p.parse_args(argv)
     try:
+        if args.fleet:
+            print(render_fleet(load_fleet_dump(args.dump)))
+            return 0
         dump = load_dump(args.dump)
         if args.timeline is not None:
             print(render_timeline(dump, args.timeline))
