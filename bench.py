@@ -25,7 +25,8 @@ def main() -> int:
     import jax
     from butterfly_tpu.core.config import llama3_8b, tiny
     from butterfly_tpu.models.common import Model
-    from butterfly_tpu.obs.benchmark import (run_decode_benchmark,
+    from butterfly_tpu.obs.benchmark import (run_chaos_benchmark,
+                                             run_decode_benchmark,
                                              run_fleet_benchmark,
                                              run_serving_benchmark)
     from butterfly_tpu.quant.int8 import init_params_quantized
@@ -134,6 +135,16 @@ def main() -> int:
     # transfer volume/hit-rate, and the zero-drop soak property.
     fleet = run_fleet_benchmark("2p2d")
     for k, v in fleet.items():
+        out[k] = round(v, 4) if isinstance(v, float) else v
+    # Chaos tier: the same 2p2d topology under the seeded stock fault
+    # plan (delays, 500s, a breaker-tripping wedge burst, drops,
+    # truncations) plus a spent-deadline burst. Carries the overload-
+    # protection counters (serving_shed_total, deadline_expired_total,
+    # breaker_open_total) and the terminal-outcome property: every
+    # request ends in tokens, 429, or 504 — zero hangs, zero silent
+    # drops (chaos_unterminal/chaos_errors == 0 when healthy).
+    chaos = run_chaos_benchmark("2p2d")
+    for k, v in chaos.items():
         out[k] = round(v, 4) if isinstance(v, float) else v
     print(json.dumps(out))
     return 0

@@ -69,6 +69,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import socket
 import time
 import urllib.error
 import urllib.request
@@ -97,10 +98,16 @@ class ControlPlaneState(RouterState):
                  handoff_timeout: float = 60.0,
                  slo_ttft_s: Optional[float] = None,
                  slo_itl_s: Optional[float] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 chaos=None):
         super().__init__(pool, policy, registry=registry,
                          read_timeout=read_timeout)
         self.page_size = policy.page_size
+        # Optional seeded fault plan (fleet/chaos.py ChaosPlan): _call
+        # consults it before every handoff leg, so network faults
+        # between control plane and replicas are injectable with the
+        # same determinism as the replica-side hooks. None = no chaos.
+        self.chaos = chaos
         # Control-plane tracer: every proxied request gets a timeline of
         # LEG spans (classify, prefill_leg, kv_export, kv_import,
         # decode_leg, direct_leg, fallback) keyed by the same
@@ -170,6 +177,20 @@ class ControlPlaneState(RouterState):
             "fleet_slo_burn_rate",
             "Fraction of the last 256 disaggregated requests that "
             "violated ANY declared objective")
+        # classified handoff-leg failures (ISSUE 8 satellite): one
+        # series per (leg, kind) instead of a bare except bucket —
+        # a dashboard can tell a timing-out prefill tier from a
+        # decode tier returning garbage
+        self._c_leg_fail = reg.counter_family(
+            "fleet_leg_failures_total",
+            "Handoff-leg failures by leg (prefill_leg/kv_export/"
+            "kv_import/decode_leg) and kind (timeout/refused/"
+            "bad_status/bad_body/chaos)", ("leg", "kind"))
+        self._c_deadline = reg.counter_family(
+            "fleet_deadline_expired_total",
+            "Requests whose deadline budget expired at the control "
+            "plane, by where (arrival, or the handoff leg about to "
+            "run)", ("where",))
 
     # -- planning -----------------------------------------------------------
 
@@ -217,6 +238,14 @@ class ControlPlaneState(RouterState):
         with self._mlock:
             counter.inc(n)
 
+    def record_leg_failure(self, leg: str, kind: str) -> None:
+        with self._mlock:
+            self._c_leg_fail.labels(leg, kind).inc()
+
+    def record_deadline(self, where: str) -> None:
+        with self._mlock:
+            self._c_deadline.labels(where).inc()
+
     def fleet_counters(self) -> Dict[str, float]:
         hits = self._c_xfer_hits.value
         miss = self._c_xfer_miss.value
@@ -230,6 +259,11 @@ class ControlPlaneState(RouterState):
             "kv_transfer_misses": miss,
             "kv_transfer_hit_rate":
                 hits / (hits + miss) if hits + miss else 0.0,
+            "leg_failures": sum(
+                c.value for c in self._c_leg_fail._children.values()),
+            "deadline_expired": sum(
+                c.value for c in self._c_deadline._children.values()),
+            "breaker_opens": self.pool.breaker_opens_total(),
         }
 
     def fleet_state(self) -> Dict:
@@ -243,11 +277,14 @@ class ControlPlaneState(RouterState):
                    if s["role"] in (tier, "both")]
             for tier in ("prefill", "decode")
         }
-        return {"replicas": snaps, "tiers": tiers,
-                "disagg_threshold": self.disagg_threshold,
-                "slo": {"ttft_s": self.slo_ttft_s,
-                        "itl_s": self.slo_itl_s},
-                "metrics": self.fleet_counters()}
+        out = {"replicas": snaps, "tiers": tiers,
+               "disagg_threshold": self.disagg_threshold,
+               "slo": {"ttft_s": self.slo_ttft_s,
+                       "itl_s": self.slo_itl_s},
+               "metrics": self.fleet_counters()}
+        if self.chaos is not None:
+            out["chaos"] = self.chaos.summary()
+        return out
 
     # -- distributed tracing ------------------------------------------------
 
@@ -312,7 +349,10 @@ class ControlPlaneState(RouterState):
                        f"?request_id={request_id}") if rep else None
                 if url is None:
                     raise LookupError(f"unknown replica {rid}")
-                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                # the pool's probe timeout governs every control-plane
+                # side channel — one knob, no stray hard-coded 5.0
+                with urllib.request.urlopen(
+                        url, timeout=self.pool.probe_timeout) as resp:
                     info["dump"] = json.loads(resp.read() or b"{}")
             except Exception as e:  # down/restarting: degrade, never 500
                 info["dump"] = None
@@ -434,6 +474,52 @@ def make_fleet_handler(state: ControlPlaneState):
                 self.headers["X-Request-Id"] = rid
             return rid
 
+        def _ensure_deadline(self, obj, t_arrive: float) -> Optional[float]:
+            """The request's latency budget as an ABSOLUTE monotonic
+            deadline: X-Deadline-Ms header wins, then a deadline_ms
+            body field. The value is the REMAINING budget at this hop —
+            every forward re-stamps the header with what's left, so the
+            budget is consumed across the whole fleet path, not reset
+            per process. Malformed values pass through untouched (the
+            replica 400s them)."""
+            dl = self.headers.get("X-Deadline-Ms")
+            if dl is None and isinstance(obj, dict):
+                dl = obj.get("deadline_ms")
+            if dl is None:
+                return None
+            try:
+                return t_arrive + float(dl) / 1e3
+            except (TypeError, ValueError):
+                return None
+
+        def _restamp_deadline(self, deadline_s: Optional[float]) -> None:
+            """Refresh X-Deadline-Ms to the remaining budget before the
+            inherited direct-dispatch proxy forwards the headers."""
+            if deadline_s is None:
+                return
+            rem = max(1, int((deadline_s - time.monotonic()) * 1e3))
+            del self.headers["X-Deadline-Ms"]
+            self.headers["X-Deadline-Ms"] = str(rem)
+
+        def _deadline_504(self, tid: int, request_id: str,
+                          t_arrive: float, where: str,
+                          detail: Optional[dict] = None) -> None:
+            """Terminal deadline verdict: 504 with where-it-died +
+            elapsed, counted and traced. `detail` merges a downstream
+            504 body (the replica's own where/elapsed) when the expiry
+            happened there."""
+            state.record_deadline(where)
+            elapsed = time.monotonic() - t_arrive
+            state.tracer.event(tid, "finish", state="deadline_expired",
+                               where=where, total_s=elapsed)
+            body = {"error": "deadline exceeded", "where": where,
+                    "elapsed_ms": elapsed * 1e3,
+                    "request_id": request_id}
+            for k in ("where", "elapsed_ms", "deadline_ms"):
+                if detail and k in detail:
+                    body[k] = detail[k]
+            self._json(504, body)
+
         def _proxy(self, path: str) -> None:
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -447,9 +533,15 @@ def make_fleet_handler(state: ControlPlaneState):
                 obj = None
             t_arrive = time.monotonic()
             request_id = self._ensure_request_id(obj)
+            deadline_s = self._ensure_deadline(obj, t_arrive)
             ids = self._token_ids(obj)
             tid = state.begin_trace(request_id, path=path,
                                     prompt_len=len(ids) if ids else None)
+            if deadline_s is not None and t_arrive >= deadline_s:
+                # arrived with a spent budget: terminal 504 here — it
+                # must not burn a classify, a handoff, or a queue slot
+                self._deadline_504(tid, request_id, t_arrive, "arrival")
+                return
             plan = self._disagg_plan(path, obj, ids)
             state.tracer.event(
                 tid, "classify", dur_s=time.monotonic() - t_arrive,
@@ -461,6 +553,7 @@ def make_fleet_handler(state: ControlPlaneState):
                 if ids:
                     state.note_seen(ids)
                 route_tokens = extract_route_tokens(body)
+                self._restamp_deadline(deadline_s)
                 t0 = time.monotonic()
                 served = self._dispatch(path, body,
                                         *state.direct_plan(route_tokens))
@@ -473,7 +566,8 @@ def make_fleet_handler(state: ControlPlaneState):
                 return
             pre, dec = plan
             self._disaggregate(obj, ids, pre, dec, tid=tid,
-                               request_id=request_id, t_arrive=t_arrive)
+                               request_id=request_id, t_arrive=t_arrive,
+                               deadline_s=deadline_s)
 
         def _token_ids(self, obj) -> Optional[List[int]]:
             """Explicit token ids only: a string prompt would hash its
@@ -515,26 +609,71 @@ def make_fleet_handler(state: ControlPlaneState):
 
         # -- the handoff ------------------------------------------------------
 
+        @staticmethod
+        def _transport_kind(e) -> str:
+            """Classify a transport failure for the
+            fleet_leg_failures_total{leg,kind} family."""
+            import http.client
+            reason = getattr(e, "reason", None)
+            if isinstance(e, (socket.timeout, TimeoutError)) \
+                    or isinstance(reason, (socket.timeout, TimeoutError)):
+                return "timeout"
+            if isinstance(e, http.client.IncompleteRead) \
+                    or isinstance(reason, http.client.IncompleteRead):
+                return "bad_body"  # died mid-body (truncated response)
+            return "refused"  # refused / reset / garbled status line
+
         def _call(self, rep: Replica, method: str, path: str,
                   obj=None, timeout: Optional[float] = None,
-                  request_id: Optional[str] = None):
+                  request_id: Optional[str] = None, leg: str = "leg",
+                  deadline_s: Optional[float] = None):
             """One control-plane HTTP call with pool feedback. Returns
             (status, parsed body) — status None on transport failure.
             `request_id` rides as X-Request-Id so the replica's tracer
             (and its kv-transfer error bodies) key the same distributed
-            request the control plane is tracing."""
+            request the control plane is tracing. `leg` names the
+            handoff leg for the classified
+            fleet_leg_failures_total{leg,kind} accounting (timeout vs
+            refused vs bad_status vs bad_body), which also feeds the
+            pool's per-replica circuit breaker. `deadline_s` (absolute
+            monotonic) caps the socket timeout at the remaining budget
+            and forwards it as X-Deadline-Ms so the replica re-anchors
+            the budget at its own arrival."""
             url = f"http://{rep.host}:{rep.port}{path}"
             data = json.dumps(obj).encode() if obj is not None else None
             headers = {"Content-Type": "application/json"}
             if request_id:
                 headers["X-Request-Id"] = request_id
+            tmo = timeout or state.read_timeout
+            if deadline_s is not None:
+                rem = deadline_s - time.monotonic()
+                headers["X-Deadline-Ms"] = str(max(1, int(rem * 1e3)))
+                tmo = min(tmo, max(1e-3, rem))
+            if state.chaos is not None:
+                from butterfly_tpu.fleet.chaos import ChaosIdent
+                inj = state.chaos.decide(
+                    ChaosIdent(rid=rep.rid, role=rep.role), path,
+                    where="call")
+                if inj is not None:
+                    if inj.kind == "delay":
+                        time.sleep(inj.delay_s)
+                    else:
+                        # every non-delay call-scope fault is "the leg
+                        # never produced a usable response" — fail it
+                        # through the SAME accounting a real refused
+                        # connect takes (pool liveness, breaker, leg
+                        # counter), so chaos exercises the real paths
+                        err = f"chaos: injected {inj.kind}"
+                        state.record_leg_failure(leg, "chaos")
+                        state.pool.note_connect_failure(rep.rid, err)
+                        state.pool.note_leg_failure(rep.rid, err)
+                        return None, {"error": err}
             req = urllib.request.Request(
                 url, data=data, method=method, headers=headers)
             state.pool.note_dispatch(rep.rid)
             try:
-                with urllib.request.urlopen(
-                        req, timeout=timeout or state.read_timeout) as resp:
-                    return resp.status, json.loads(resp.read() or b"{}")
+                with urllib.request.urlopen(req, timeout=tmo) as resp:
+                    status, raw = resp.status, resp.read()
             except urllib.error.HTTPError as e:
                 try:
                     body = json.loads(e.read() or b"{}")
@@ -543,20 +682,49 @@ def make_fleet_handler(state: ControlPlaneState):
                 e.close()
                 if e.code == 503:
                     state.pool.note_wedged(rep.rid, "503 during handoff")
+                if e.code >= 500 and e.code != 504:
+                    # 5xx = the replica failed the leg (504 is the
+                    # request's OWN deadline verdict, not replica
+                    # health — it must not trip the breaker)
+                    state.record_leg_failure(leg, "bad_status")
+                    state.pool.note_leg_failure(rep.rid, f"http {e.code}")
+                else:
+                    state.pool.note_leg_ok(rep.rid)
                 return e.code, body
-            except Exception as e:  # refused / reset / timeout / bad JSON
+            except Exception as e:  # refused / reset / timeout
+                kind = self._transport_kind(e)
+                state.record_leg_failure(leg, kind)
                 state.pool.note_connect_failure(rep.rid, str(e))
+                state.pool.note_leg_failure(rep.rid, str(e))
                 return None, {"error": str(e)}
             finally:
                 state.pool.note_done(rep.rid)
+            try:
+                body = json.loads(raw or b"{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                # a 200 whose body doesn't parse: the replica (or the
+                # network) corrupted the leg — distinct failure kind
+                state.record_leg_failure(leg, "bad_body")
+                state.pool.note_leg_failure(rep.rid, f"bad body: {e}")
+                return None, {"error": f"bad body: {e}"}
+            state.pool.note_leg_ok(rep.rid)
+            return status, body
 
-        def _fallback(self, obj, ids, tid, t_arrive, reason) -> None:
+        def _fallback(self, obj, ids, tid, t_arrive, reason,
+                      request_id: str = "",
+                      deadline_s: Optional[float] = None) -> None:
             """A handoff leg failed before any client byte: re-dispatch
             the ORIGINAL request direct (the decode replica recomputes
-            the whole prompt — slower, never wrong)."""
+            the whole prompt — slower, never wrong). A spent deadline
+            short-circuits to 504 instead: re-running the prompt for a
+            client that already missed its budget is pure waste."""
+            if deadline_s is not None and time.monotonic() >= deadline_s:
+                self._deadline_504(tid, request_id, t_arrive, "fallback")
+                return
             state.inc(state._c_fallback)
             state.tracer.event(tid, "fallback", reason=reason)
             body = json.dumps(obj).encode()
+            self._restamp_deadline(deadline_s)
             t0 = time.monotonic()
             served = self._dispatch("/generate", body,
                                     *state.direct_plan(ids))
@@ -569,7 +737,8 @@ def make_fleet_handler(state: ControlPlaneState):
 
         def _disaggregate(self, obj: dict, ids: List[int],
                           pre: Replica, dec: Replica, tid: int,
-                          request_id: str, t_arrive: float) -> None:
+                          request_id: str, t_arrive: float,
+                          deadline_s: Optional[float] = None) -> None:
             t0 = t_arrive  # TTFT/total measure from client arrival
             state.inc(state._c_disagg)
             max_tokens = int(obj.get("max_tokens",
@@ -577,20 +746,29 @@ def make_fleet_handler(state: ControlPlaneState):
             # 1. prefill leg: full prompt + first token on the prefill tier
             a_req = {"tokens": ids, "max_tokens": 1,
                      "request_id": request_id}
-            for k in ("temperature", "stop_token"):
+            for k in ("temperature", "stop_token", "priority"):
                 if k in obj:
                     a_req[k] = obj[k]
             t_leg = time.monotonic()
             code, a = self._call(pre, "POST", "/generate", a_req,
                                  timeout=state.handoff_timeout,
-                                 request_id=request_id)
+                                 request_id=request_id, leg="prefill_leg",
+                                 deadline_s=deadline_s)
             state.tracer.event(tid, "prefill_leg",
                                dur_s=time.monotonic() - t_leg,
                                replica=pre.rid,
                                status="ok" if code == 200 else f"{code}")
+            if code == 504:
+                # the replica's own deadline verdict: propagate, never
+                # fall back — a re-prefill for a blown budget is waste
+                self._deadline_504(tid, request_id, t_arrive,
+                                   "prefill_leg", detail=a)
+                return
             if code != 200 or not a.get("tokens"):
                 self._fallback(obj, ids, tid, t_arrive,
-                               f"prefill leg {code}")
+                               f"prefill leg {code}",
+                               request_id=request_id,
+                               deadline_s=deadline_s)
                 return
             ttft = time.monotonic() - t0
             state.observe(state._h_ttft, ttft)
@@ -600,11 +778,16 @@ def make_fleet_handler(state: ControlPlaneState):
             imported = 0
             hashes = [h.hex() for h in chain_block_hashes(ids,
                                                           state.page_size)]
-            if hashes:
+            if hashes and not (deadline_s is not None
+                               and time.monotonic() >= deadline_s):
+                # transfer is an optimization: with a spent budget it
+                # is simply skipped (the 504 verdict comes from the
+                # decode leg below, which owns the terminal response)
                 t_leg = time.monotonic()
                 code, exp = self._call(
                     pre, "GET", "/kv/pages?hashes=" + ",".join(hashes),
-                    timeout=state.handoff_timeout, request_id=request_id)
+                    timeout=state.handoff_timeout, request_id=request_id,
+                    leg="kv_export", deadline_s=deadline_s)
                 n_pages = len(exp.get("pages", ())) if code == 200 else 0
                 state.tracer.event(
                     tid, "kv_export", dur_s=time.monotonic() - t_leg,
@@ -622,7 +805,9 @@ def make_fleet_handler(state: ControlPlaneState):
                         code, imp = self._call(dec, "POST", "/kv/import",
                                                exp,
                                                timeout=state.handoff_timeout,
-                                               request_id=request_id)
+                                               request_id=request_id,
+                                               leg="kv_import",
+                                               deadline_s=deadline_s)
                         if code == 200:
                             # skipped = already cached on B (an earlier
                             # transfer or B's own traffic): warm either
@@ -647,22 +832,36 @@ def make_fleet_handler(state: ControlPlaneState):
                                     a.get("stopped", False), meta, dec.rid,
                                     tid)
                 return
+            if deadline_s is not None and time.monotonic() >= deadline_s:
+                # budget spent between prefill and decode: terminal 504
+                # — the decode tier never sees (or seats) this request
+                self._deadline_504(tid, request_id, t_arrive,
+                                   "decode_leg")
+                return
             b_req = {"tokens": ids + first, "max_tokens": max_tokens - 1,
                      "request_id": request_id}
-            for k in ("temperature", "stop_token", "top_p", "top_k"):
+            for k in ("temperature", "stop_token", "top_p", "top_k",
+                      "priority"):
                 if k in obj:
                     b_req[k] = obj[k]
             t_leg = time.monotonic()
             code, b = self._call(dec, "POST", "/generate", b_req,
-                                 request_id=request_id)
+                                 request_id=request_id, leg="decode_leg",
+                                 deadline_s=deadline_s)
             state.tracer.event(tid, "decode_leg",
                                dur_s=time.monotonic() - t_leg,
                                replica=dec.rid,
                                tokens=len(b.get("tokens", ())),
                                status="ok" if code == 200 else f"{code}")
+            if code == 504:
+                self._deadline_504(tid, request_id, t_arrive,
+                                   "decode_leg", detail=b)
+                return
             if code != 200:
                 self._fallback(obj, ids, tid, t_arrive,
-                               f"decode leg {code}")
+                               f"decode leg {code}",
+                               request_id=request_id,
+                               deadline_s=deadline_s)
                 return
             self._finish_disagg(
                 t0, first + [int(t) for t in b.get("tokens", ())],

@@ -48,7 +48,8 @@ def parse_topology(spec: str) -> Tuple[int, int]:
 
 
 class ReplicaHandle:
-    def __init__(self, state, httpd, sched, role: str, host: str):
+    def __init__(self, state, httpd, sched, role: str, host: str,
+                 handler_cls=None):
         self.state = state
         self.httpd = httpd
         self.sched = sched
@@ -57,6 +58,9 @@ class ReplicaHandle:
         self.port = httpd.server_port
         self.rid = f"{host}:{self.port}"
         self.url = f"http://{self.rid}"
+        # the handler class the front was built with (incl. any chaos
+        # wrapper) so a restart keeps injecting the same fault plan
+        self.handler_cls = handler_cls
 
     def restart(self) -> None:
         """Bounce the HTTP front on the same port (connects fail for
@@ -64,10 +68,10 @@ class ReplicaHandle:
         tier; scheduler + KV state survive, as they would behind a
         real graceful-restart supervisor)."""
         from butterfly_tpu.serve.server import make_handler
+        handler = self.handler_cls or make_handler(self.state)
         self.httpd.shutdown()
         self.httpd.server_close()
-        self.httpd = ThreadingHTTPServer((self.host, self.port),
-                                         make_handler(self.state))
+        self.httpd = ThreadingHTTPServer((self.host, self.port), handler)
         threading.Thread(target=self.httpd.serve_forever,
                          daemon=True).start()
 
@@ -103,14 +107,18 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
                   host: str = "127.0.0.1", warm: bool = True,
                   warm_len: Optional[int] = None,
                   slo_ttft_s: Optional[float] = None,
-                  slo_itl_s: Optional[float] = None) -> ReplicaHandle:
+                  slo_itl_s: Optional[float] = None,
+                  chaos=None, chaos_index: int = 0) -> ReplicaHandle:
     """One in-process serve replica on a fresh loopback port. Prefix
     caching is always on — it is the registry KV transfer addresses
     pages through. Tracing is always on — the fleet trace merge
     (GET /fleet/trace) joins each replica's /debug/requests timeline
     into the cross-replica waterfall, exactly like a real `butterfly
     serve` replica (which traces by default). Warming runs BEFORE the
-    scheduler loop thread starts (one thread ticks a scheduler, ever)."""
+    scheduler loop thread starts (one thread ticks a scheduler, ever).
+    `chaos` (fleet/chaos.py ChaosPlan) wraps the HTTP handler in the
+    seeded fault-injection hook; `chaos_index` is this replica's index
+    within its role tier (plans target e.g. 'decode:0')."""
     from butterfly_tpu.engine.serving import ServingEngine
     from butterfly_tpu.obs.trace import Tracer
     from butterfly_tpu.sched.scheduler import Scheduler
@@ -136,9 +144,19 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
             assert w.done
     state = ServerState(sched, ByteTokenizer(), role=role)
     state.thread.start()
-    httpd = ThreadingHTTPServer((host, 0), make_handler(state))
+    handler_cls = make_handler(state)
+    ident = None
+    if chaos is not None:
+        from butterfly_tpu.fleet.chaos import ChaosIdent, make_chaos_handler
+        ident = ChaosIdent(role=role, index=chaos_index)
+        handler_cls = make_chaos_handler(handler_cls, chaos, ident)
+    httpd = ThreadingHTTPServer((host, 0), handler_cls)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    return ReplicaHandle(state, httpd, sched, role, host)
+    handle = ReplicaHandle(state, httpd, sched, role, host,
+                           handler_cls=handler_cls)
+    if ident is not None:
+        ident.rid = handle.rid  # known only after the port binds
+    return handle
 
 
 def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
@@ -149,11 +167,14 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
                 warm: bool = True,
                 warm_len: Optional[int] = None,
                 slo_ttft_s: Optional[float] = None,
-                slo_itl_s: Optional[float] = None) -> FleetHandle:
+                slo_itl_s: Optional[float] = None,
+                chaos=None) -> FleetHandle:
     """Spin the whole topology: replicas (one shared tiny-model param
     tree unless the caller provides model+params) + control plane, and
     optionally warm every replica's serving programs so the first
-    measured request doesn't pay the XLA compile."""
+    measured request doesn't pay the XLA compile. `chaos` (a
+    fleet/chaos.py ChaosPlan) installs the seeded fault hooks on every
+    replica front AND the control plane's handoff legs."""
     import jax
     from butterfly_tpu.models.common import Model
 
@@ -166,12 +187,17 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
         raise ValueError("empty topology")
     if n_pre == 0:  # '4' shorthand: a role-less pool
         roles = ["both"] * n_dec
-    replicas = [start_replica(model, params, role, page_size=page_size,
-                              max_batch=max_batch, max_seq=max_seq,
-                              num_pages=num_pages, warm=warm,
-                              warm_len=warm_len, slo_ttft_s=slo_ttft_s,
-                              slo_itl_s=slo_itl_s)
-                for role in roles]
+    tier_index: dict = {}
+    replicas = []
+    for role in roles:
+        idx = tier_index.get(role, 0)
+        tier_index[role] = idx + 1
+        replicas.append(start_replica(
+            model, params, role, page_size=page_size,
+            max_batch=max_batch, max_seq=max_seq,
+            num_pages=num_pages, warm=warm,
+            warm_len=warm_len, slo_ttft_s=slo_ttft_s,
+            slo_itl_s=slo_itl_s, chaos=chaos, chaos_index=idx))
     registry = MetricsRegistry()
     pool = ReplicaPool([r.rid for r in replicas],
                        probe_interval=probe_interval, registry=registry,
@@ -182,7 +208,8 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
                                  read_timeout=120.0,
                                  disagg_threshold=disagg_threshold,
                                  slo_ttft_s=slo_ttft_s,
-                                 slo_itl_s=slo_itl_s)
+                                 slo_itl_s=slo_itl_s,
+                                 chaos=chaos)
     pool.probe_all()  # learn roles before the first request routes
     pool.start()
     cp_httpd = ThreadingHTTPServer(("127.0.0.1", 0),

@@ -356,6 +356,7 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
         "fleet_topology": topology,
         "fleet_requests": soak["sent"],
         "fleet_dropped": soak["failed"],
+        "fleet_outcomes": soak.get("outcomes", {}),
         "fleet_disaggregated": soak["disaggregated"],
         "fleet_ttft_p50": soak["ttft_p50_s"],
         "fleet_ttft_p95": soak["ttft_p95_s"],
@@ -371,4 +372,88 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
         "fleet_slo_ttft_ms": slo_ttft_ms,
         "fleet_slo_itl_ms": slo_itl_ms,
         "fleet_slo_attainment": soak.get("slo_attainment"),
+    }
+
+
+def run_chaos_benchmark(topology: str = "2p2d", *, clients: int = 3,
+                        requests_per_client: int = 4,
+                        max_tokens: int = 8, page_size: int = 8,
+                        max_batch: int = 2, disagg_threshold: int = 16,
+                        prefix_share: float = 0.5,
+                        seed: int = 0) -> Dict:
+    """Chaos soak benchmark (ISSUE 8 acceptance): the in-process fleet
+    under the SEEDED stock fault plan (fleet/chaos.py default_plan —
+    delayed prefill, 500s and a breaker-tripping wedge burst on the
+    decode tier, dropped and truncated connections) driven by loadgen,
+    plus a burst of already-expired deadline requests.
+
+    The pass property is system-level: every submitted request reaches
+    a TERMINAL outcome (tokens, 429, or 504) — zero un-started drops,
+    zero client hangs — while the faults actually fire. The JSON keys
+    carry the overload-protection counters: serving_shed_total (summed
+    over replica schedulers), deadline_expired_total (replicas +
+    control plane), breaker_open_total (pool-wide open transitions),
+    and the classified leg-failure count."""
+    from butterfly_tpu.fleet.chaos import default_plan
+    from butterfly_tpu.fleet.harness import start_fleet
+
+    lg = _loadgen()
+    plan = default_plan(seed=seed)
+    shared_len = max(page_size * 4, disagg_threshold)
+    tail = page_size // 2
+    # generous declared objectives: the SLO/shed machinery is ACTIVE
+    # (counters live, shed path armed) without turning CPU-smoke
+    # latency noise into nondeterministic shedding
+    fleet = start_fleet(topology, page_size=page_size,
+                        max_batch=max_batch,
+                        max_seq=shared_len + tail + max_tokens + 16,
+                        disagg_threshold=disagg_threshold,
+                        chaos=plan, slo_ttft_s=120.0, slo_itl_s=120.0,
+                        warm_len=shared_len + tail)
+    try:
+        # phase 1 — the chaos load: faults fire across both tiers while
+        # closed-loop clients demand terminal outcomes
+        load = lg.run_load(fleet.url, clients=clients,
+                           requests_per_client=requests_per_client,
+                           prefix_share=prefix_share,
+                           shared_len=shared_len, tail_len=tail,
+                           max_tokens=max_tokens, seed=seed)
+        # phase 2 — a spent-budget burst: every request arrives with a
+        # dead deadline and must 504 at the control plane, never
+        # touching a queue or a decode slot
+        expired = lg.run_load(fleet.url, clients=1,
+                              requests_per_client=3,
+                              prefix_share=0.0, shared_len=shared_len,
+                              tail_len=tail, max_tokens=max_tokens,
+                              seed=seed + 1, deadline_ms=0.0)
+        shed = sum(r.sched.metrics().get("shed_total", 0.0)
+                   for r in fleet.replicas)
+        deadline = sum(
+            r.sched.metrics().get("deadline_expired_total", 0.0)
+            for r in fleet.replicas)
+        cp = fleet.state.fleet_counters()
+        deadline += cp["deadline_expired"]
+        breaker_opens = fleet.state.pool.breaker_opens_total()
+    finally:
+        fleet.stop()
+    o1, o2 = load["outcomes"], expired["outcomes"]
+    sent = load["sent"] + expired["sent"]
+    terminal = load["terminal"] + expired["terminal"]
+    return {
+        "chaos_topology": topology,
+        "chaos_seed": seed,
+        "chaos_requests": sent,
+        "chaos_terminal": terminal,
+        "chaos_unterminal": sent - terminal,
+        "chaos_errors": o1["error"] + o2["error"],
+        "chaos_shed_429": o1["shed_429"] + o2["shed_429"],
+        "chaos_deadline_504": o1["deadline_504"] + o2["deadline_504"],
+        "chaos_injected": plan.total_injected,
+        "chaos_fallbacks": cp["disagg_fallbacks"],
+        "chaos_leg_failures": cp["leg_failures"],
+        # the overload-protection counter families (ISSUE 8 acceptance
+        # keys in the bench JSON)
+        "serving_shed_total": shed,
+        "deadline_expired_total": deadline,
+        "breaker_open_total": breaker_opens,
     }

@@ -51,7 +51,8 @@ class Replica:
                  "queue_depth", "active", "fails", "probes", "last_probe_t",
                  "next_probe_t", "last_error", "role", "free_pages",
                  "inflight", "clock_offset", "metrics_families",
-                 "metrics_t")
+                 "metrics_t", "breaker", "breaker_fails",
+                 "breaker_next_probe_t", "breaker_opens")
 
     def __init__(self, rid: str, host: str, port: int):
         self.rid = rid
@@ -85,6 +86,17 @@ class Replica:
         self.last_probe_t: Optional[float] = None
         self.next_probe_t = 0.0  # due immediately
         self.last_error = ""
+        # Serving-path circuit breaker, ORTHOGONAL to probe liveness: a
+        # replica whose /health answers fine can still fail every
+        # request leg (wedged scheduler, chaos-injected faults,
+        # timeouts). `breaker_threshold` consecutive leg failures OPEN
+        # the breaker — candidates() skips it entirely — and after
+        # `breaker_cooldown` it goes HALF-OPEN: exactly one probe
+        # request is let through; success closes it, failure re-opens.
+        self.breaker = "closed"          # closed | open | half_open
+        self.breaker_fails = 0           # consecutive leg failures
+        self.breaker_next_probe_t = 0.0  # when open -> half_open
+        self.breaker_opens = 0           # lifetime open transitions
 
     @property
     def state(self) -> str:
@@ -119,6 +131,9 @@ class Replica:
                 "free_pages": self.free_pages, "inflight": self.inflight,
                 "clock_offset_s": self.clock_offset,
                 "consecutive_failures": self.fails,
+                "breaker": self.breaker,
+                "breaker_fails": self.breaker_fails,
+                "breaker_opens": self.breaker_opens,
                 "probes": self.probes, "last_error": self.last_error}
 
 
@@ -137,12 +152,18 @@ class ReplicaPool:
     def __init__(self, backends: List[str], probe_interval: float = 0.5,
                  probe_timeout: float = 2.0, dead_after: int = 3,
                  backoff_base: float = 0.5, backoff_max: float = 10.0,
-                 registry=None, scrape_metrics: bool = False):
+                 registry=None, scrape_metrics: bool = False,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown: float = 2.0):
         if not backends:
             raise ValueError("router needs at least one backend")
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
         self.dead_after = dead_after
+        # serving-path circuit breaker (see Replica.breaker): leg
+        # failures to open, seconds open before a half-open probe
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
         # fleet mode: each successful /health probe is followed by a
         # GET /metrics scrape, parsed and cached on the Replica — the
         # control plane's /fleet/metrics rollup reads the cache instead
@@ -163,12 +184,18 @@ class ReplicaPool:
             self.replicas[rid] = Replica(rid, host, port)
         # per-replica outstanding gauge on the router's own registry
         self._g_out = None
+        self._c_breaker_open = None
         if registry is not None:
             self._g_out = registry.gauge_family(
                 "router_outstanding_requests",
                 "Requests currently proxied to each replica", ("replica",))
             for rid in self.replicas:
                 self._g_out.labels(rid).set(0)
+            self._c_breaker_open = registry.counter_family(
+                "router_breaker_open_total",
+                "Circuit-breaker open transitions per replica "
+                "(breaker_threshold consecutive request-leg failures; "
+                "half-open probes after breaker_cooldown)", ("replica",))
 
     # -- membership queries --------------------------------------------------
 
@@ -184,19 +211,37 @@ class ReplicaPool:
         else (all degraded — e.g. one connect blip marked the only
         replica before its re-probe) the degraded ones as a last resort.
         Dead and draining members are never returned — dead is the
-        pool's signal the proxy must not waste a connect on it.
+        pool's signal the proxy must not waste a connect on it — and
+        neither are members whose circuit breaker is OPEN (a half-open
+        member is returned only while it has no in-flight probe, so one
+        request at a time tests the recovery).
         `role` restricts to one fleet tier ('prefill'/'decode'; 'both'
         replicas belong to every tier) — the control plane's
         disaggregated planner asks per tier, the plain router asks for
-        all."""
+        all; while a whole tier's breakers are open the planner gets an
+        empty list and the disagg path degrades to direct dispatch."""
+        now = time.monotonic()
         with self._lock:
             live = [r for r in self.replicas.values()
-                    if r.routable and r.serves(role)]
+                    if r.routable and r.serves(role)
+                    and self._breaker_admits(r, now)]
             if live:
                 return live
             return [r for r in self.replicas.values()
                     if r.liveness == DEGRADED and not r.drain
-                    and r.serves(role)]
+                    and r.serves(role) and self._breaker_admits(r, now)]
+
+    def _breaker_admits(self, r: Replica, now: float) -> bool:
+        """Lock held. Open breakers flip to half-open once the cooldown
+        passes; a half-open member admits exactly one probe request at
+        a time (outstanding == 0)."""
+        if r.breaker == "closed":
+            return True
+        if r.breaker == "open":
+            if now < r.breaker_next_probe_t:
+                return False
+            r.breaker = "half_open"
+        return r.outstanding == 0
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -234,6 +279,48 @@ class ReplicaPool:
             if r.liveness == LIVE:
                 r.liveness = DEGRADED
             r.last_error = err or "503 from replica"
+
+    # -- circuit breaker (request-leg feedback) -----------------------------
+
+    def note_leg_ok(self, rid: str) -> None:
+        """A request leg to `rid` produced a usable response: reset the
+        consecutive-failure count; a half-open breaker CLOSES (the
+        probe succeeded — full restore)."""
+        with self._lock:
+            r = self.replicas.get(rid)
+            if r is None:
+                return
+            r.breaker_fails = 0
+            r.breaker = "closed"
+
+    def note_leg_failure(self, rid: str, err: str = "") -> None:
+        """A request leg to `rid` failed (refused, wedged-503, timeout,
+        truncated, bad body). `breaker_threshold` consecutive failures
+        open the breaker; any failure during half-open re-opens it
+        immediately — one bad probe is enough evidence."""
+        with self._lock:
+            r = self.replicas.get(rid)
+            if r is None:
+                return
+            r.breaker_fails += 1
+            if r.breaker == "half_open" \
+                    or r.breaker_fails >= self.breaker_threshold:
+                self._open_breaker(r, err)
+
+    def _open_breaker(self, r: Replica, err: str) -> None:
+        """Lock held."""
+        if r.breaker != "open":
+            r.breaker_opens += 1
+            if self._c_breaker_open is not None:
+                self._c_breaker_open.labels(r.rid).inc()
+        r.breaker = "open"
+        r.breaker_next_probe_t = time.monotonic() + self.breaker_cooldown
+        if err:
+            r.last_error = err
+
+    def breaker_opens_total(self) -> int:
+        with self._lock:
+            return sum(r.breaker_opens for r in self.replicas.values())
 
     # -- admin ---------------------------------------------------------------
 

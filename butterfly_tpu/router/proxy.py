@@ -108,8 +108,9 @@ class RouterState:
         reg = self.registry
         self._c_req = reg.counter_family(
             "router_requests_total",
-            "Proxy attempts by replica and outcome (ok/upstream_error/"
-            "refused/wedged/truncated)", ("replica", "outcome"))
+            "Proxy attempts by replica and outcome (ok/deadline/"
+            "upstream_error/refused/wedged/truncated/client_gone)",
+            ("replica", "outcome"))
         self._c_retry = reg.counter(
             "router_retries_total",
             "Requests re-dispatched to another replica before any "
@@ -259,9 +260,13 @@ def make_router_handler(state: RouterState):
             try:
                 headers = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json")}
-                rid_hdr = self.headers.get("X-Request-Id")
-                if rid_hdr:
-                    headers["X-Request-Id"] = rid_hdr
+                # X-Deadline-Ms rides through: the replica re-anchors
+                # the remaining budget at ITS arrival (forwarding is
+                # fast relative to any real deadline)
+                for k in ("X-Request-Id", "X-Deadline-Ms", "X-Priority"):
+                    v = self.headers.get(k)
+                    if v:
+                        headers[k] = v
                 try:
                     conn.request("POST", path, body=body, headers=headers)
                     resp = conn.getresponse()
@@ -270,6 +275,7 @@ def make_router_handler(state: RouterState):
                     # replica is gone — fail it fast so the NEXT request
                     # skips it without waiting for the prober
                     state.pool.note_connect_failure(rep.rid, str(e))
+                    state.pool.note_leg_failure(rep.rid, str(e))
                     state.count(rep.rid, "refused")
                     return _RETRY
                 if resp.status == 503:
@@ -281,7 +287,23 @@ def make_router_handler(state: RouterState):
                     except OSError:
                         pass
                     state.pool.note_wedged(rep.rid, "wedged-503")
+                    state.pool.note_leg_failure(rep.rid, "wedged-503")
                     state.count(rep.rid, "wedged")
+                    return _RETRY
+                if resp.status >= 500 and resp.status != 504:
+                    # replica-side fault (500/502/...): no client byte
+                    # has been sent, so the single failover rule says
+                    # retry next-best rather than forward the fault.
+                    # 504 is EXEMPT — it is the request's own deadline
+                    # verdict (terminal), not replica health, and a
+                    # retry would burn compute for a blown budget.
+                    try:
+                        resp.read()
+                    except OSError:
+                        pass
+                    state.pool.note_leg_failure(rep.rid,
+                                                f"http {resp.status}")
+                    state.count(rep.rid, "upstream_error")
                     return _RETRY
                 ctype = resp.getheader("Content-Type", "")
                 if resp.status == 200 and \
@@ -293,8 +315,10 @@ def make_router_handler(state: RouterState):
                     data = resp.read()
                 except (OSError, http.client.HTTPException) as e:
                     state.pool.note_connect_failure(rep.rid, str(e))
+                    state.pool.note_leg_failure(rep.rid, str(e))
                     state.count(rep.rid, "refused")
                     return _RETRY
+                state.pool.note_leg_ok(rep.rid)
                 fwd = {"X-Routed-To": rep.rid}
                 for k in ("X-Request-Id", "Retry-After"):
                     v = resp.getheader(k)
@@ -308,8 +332,10 @@ def make_router_handler(state: RouterState):
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
-                state.count(rep.rid, "ok" if resp.status < 500
-                            else "upstream_error")
+                # >= 500 was retried above; the only 5xx that lands
+                # here is 504 — the request's own deadline verdict
+                state.count(rep.rid, "deadline" if resp.status == 504
+                            else "ok")
                 return _SENT
             finally:
                 conn.close()
@@ -340,6 +366,8 @@ def make_router_handler(state: RouterState):
                     # sees an incomplete body.
                     state.pool.note_connect_failure(rep.rid,
                                                     f"mid-stream: {e}")
+                    state.pool.note_leg_failure(rep.rid,
+                                                f"mid-stream: {e}")
                     state.count(rep.rid, "truncated")
                     self.close_connection = True
                     return _SENT
@@ -360,6 +388,7 @@ def make_router_handler(state: RouterState):
                 self.wfile.write(b"0\r\n\r\n")
             except OSError:
                 pass
+            state.pool.note_leg_ok(rep.rid)
             state.count(rep.rid, "ok")
             return _SENT
 

@@ -82,6 +82,17 @@ class Request:
     # client-supplied passthrough id (X-Request-Id / body "request_id"):
     # appears verbatim in traces so client logs join server timelines
     client_id: Optional[str] = None
+    # priority class: "interactive" sheds last and is preempted last;
+    # "batch" is the first shed under predicted-TTFT pressure and the
+    # preferred preemption victim under page pressure
+    priority: str = "interactive"
+    # absolute time.monotonic() deadline (None = none declared). The
+    # scheduler scrubs expired waiters every tick and cancels expired
+    # runners at the next drain barrier — an expired request never
+    # occupies a decode slot past its budget.
+    deadline_s: Optional[float] = None
+    # where the deadline fired ("waiting" | "running"), for the 504 body
+    expired_where: Optional[str] = None
     # runtime state
     output: List[int] = field(default_factory=list)
     slot: Optional[int] = None
@@ -103,7 +114,7 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in ("finished", "cancelled")
+        return self.state in ("finished", "cancelled", "expired")
 
     @property
     def all_tokens(self) -> List[int]:
@@ -295,6 +306,25 @@ class Scheduler:
             "ANY declared objective (0 = meeting SLO, 1 = burning the "
             "whole error budget) — the rolling signal SLO-aware "
             "admission and autoscaling read")
+        # Overload protection (ISSUE 8): deadline expiry + SLO-aware
+        # admission shedding. Shedding activates only with a declared
+        # TTFT objective AND observed latency evidence — a cold server
+        # never sheds blind.
+        self._c_deadline = reg.counter_family(
+            "deadline_expired_total",
+            "Requests that blew their declared deadline (deadline_ms / "
+            "X-Deadline-Ms), by where they died: scrubbed from the "
+            "waiting queue, or cancelled out of a decode slot",
+            ("where",))
+        self._c_shed = reg.counter_family(
+            "shed_total",
+            "Requests shed at admission (429) because predicted TTFT "
+            "busts the declared --slo-ttft-ms, by priority class "
+            "(batch sheds at the objective, interactive at "
+            "interactive_slack x it)", ("priority",))
+        # interactive requests tolerate this multiple of the TTFT
+        # objective before shedding — batch is always shed first
+        self.interactive_slack = 2.0
         # rolling attainment window backing the burn-rate gauge
         self._slo_window: Deque[float] = deque(maxlen=256)
         # latency reservoirs: both bounded to the same recent window so
@@ -321,7 +351,9 @@ class Scheduler:
     def submit(self, prompt: List[int], max_new_tokens: int = 128,
                temperature: float = 0.0, stop_token: int = -1,
                on_token=None, on_finish=None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               priority: str = "interactive",
+               deadline_s: Optional[float] = None) -> Request:
         # Reject what can never fit: a request that exceeds the per-seq
         # page limit or the whole pool would self-preempt forever.
         worst = -(-(len(prompt) + max_new_tokens) // self.alloc.page_size)
@@ -335,9 +367,13 @@ class Scheduler:
                 "speculative serving is greedy-only (stochastic drafts "
                 "would need the rejection-sampling correction): submit "
                 "with temperature=0 or disable speculative_gamma")
+        if priority not in ("interactive", "batch"):
+            raise ValueError(f"unknown priority {priority!r}: expected "
+                             "'interactive' or 'batch'")
         req = Request(id=next(self._ids), prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       stop_token=stop_token, client_id=request_id,
+                      priority=priority, deadline_s=deadline_s,
                       on_token=on_token, on_finish=on_finish)
         self.waiting.append(req)
         self._c_requests.inc()
@@ -346,6 +382,80 @@ class Scheduler:
                                      prompt_len=len(prompt),
                                      max_new_tokens=max_new_tokens)
         return req
+
+    # -- overload protection (ISSUE 8) --------------------------------------
+
+    def predict_ttft(self, prompt_len: int) -> Optional[float]:
+        """Admission-time TTFT prediction for a hypothetical new
+        arrival: the prefill backlog ahead of it (waiting prompts +
+        unfinished prefill-group work + its own prompt) in
+        prefill_chunk-budget rounds, plus one round per waiter ahead
+        (slot contention), each round costed at the rolling
+        per-request mean ITL — every chunk round shares a tick with a
+        decode block, so the recent inter-token gap IS the tick cost a
+        queued request pays. Returns None without latency evidence
+        (cold server: never predict, never shed blind). Deliberately
+        cheap — a misprediction costs one early 429 or one late
+        admission, never correctness."""
+        window = self._itl_means or self._itls
+        if not window:
+            return None
+        tick_s = sum(window) / len(window)
+        chunk = max(1, self.engine.runtime.prefill_chunk)
+        backlog = prompt_len
+        backlog += sum(len(r.all_tokens) - r.prefilled
+                       for r in self._prefill_group)
+        backlog += sum(len(r.all_tokens) for r in self.waiting)
+        rounds = -(-backlog // chunk) + len(self.waiting)
+        return rounds * tick_s
+
+    def shed_decision(self, prompt_len: int,
+                      priority: str = "interactive") -> Optional[float]:
+        """SLO-aware admission: seconds to advertise as Retry-After
+        when the request should be SHED (predicted TTFT busts the
+        declared objective), or None to admit. Batch sheds at the
+        objective; interactive tolerates interactive_slack x it, so
+        under rising load batch traffic is always turned away first.
+        No declared --slo-ttft-ms = no shedding, ever."""
+        if self.slo_ttft_s is None:
+            return None
+        pred = self.predict_ttft(prompt_len)
+        if pred is None:
+            return None
+        limit = self.slo_ttft_s * (self.interactive_slack
+                                   if priority == "interactive" else 1.0)
+        if pred <= limit:
+            return None
+        self._c_shed.labels(priority).inc()
+        # how long until enough backlog drains that the prediction
+        # would pass — the honest Retry-After, not a constant
+        return max(1.0, pred - limit)
+
+    def _expire_due(self) -> None:
+        """Deadline scrub, run at every tick start. Expired waiters
+        drop straight out of the queue (they never cost a prefill);
+        expired runners force a FULL drain barrier first — their pages
+        must not be reclaimed under an in-flight block's writes — then
+        leave their decode slot. Either way the request finishes
+        state="expired" and its waiter is answered (the server turns
+        that into the 504)."""
+        now = time.monotonic()
+        for req in [r for r in self.waiting
+                    if r.deadline_s is not None and now >= r.deadline_s]:
+            self.waiting.remove(req)
+            self._expire(req, "waiting")
+        live = [r for r in self._all_live
+                if r.deadline_s is not None and now >= r.deadline_s]
+        if live:
+            self._drain_inflight()
+            for req in live:
+                if not req.done:  # the drain may have finished it
+                    self._expire(req, "running")
+
+    def _expire(self, req: Request, where: str) -> None:
+        req.expired_where = where
+        self._c_deadline.labels(where).inc()
+        self._finish(req, state="expired")
 
     def cancel(self, req: Request) -> None:
         """Abort a request (e.g. client disconnect): frees slot + pages.
@@ -445,6 +555,9 @@ class Scheduler:
         spec = rt.speculative_gamma > 0
         k = max(1, rt.decode_steps_per_tick)
         depth = max(1, rt.inflight_blocks)
+        # deadline scrub first: an expired request must not survive
+        # into this tick's admission or decode dispatch
+        self._expire_due()
         self._t_host0 = time.monotonic()
         self._had_inflight_at_host0 = bool(self._inflight)
         self._idle_at_host0 = self._had_inflight_at_host0 and \
@@ -545,6 +658,10 @@ class Scheduler:
             m["itl_req_mean_p50"] = float(np.percentile(a, 50))
             m["itl_req_mean_p95"] = float(np.percentile(a, 95))
         m["inflight_depth"] = float(self._g_inflight.value)
+        m["deadline_expired_total"] = sum(
+            c.value for c in self._c_deadline._children.values())
+        m["shed_total"] = sum(
+            c.value for c in self._c_shed._children.values())
         if self.slo_ttft_s is not None or self.slo_itl_s is not None:
             viol = sum(c.value for c in
                        self._c_slo_viol._children.values())
@@ -1076,8 +1193,12 @@ class Scheduler:
             if self._inflight or self._pending_first:
                 self._drain_inflight()
                 continue
+            # batch-class requests are preferred victims (shed-first
+            # priority semantics); within a class the youngest loses —
+            # so an old batch job still yields to a young interactive
+            # one, but interactive never pays for batch's pages
             victim = max(self.running + self._prefill_group,
-                         key=lambda r: r.t_arrive)
+                         key=lambda r: (r.priority == "batch", r.t_arrive))
             self._preempt(victim)
             if victim is req:
                 return

@@ -243,6 +243,18 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--max-batch", type=int, default=2)
     f.add_argument("--max-seq", type=int, default=128)
     f.add_argument("--disagg-threshold", type=int, default=16)
+    f.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="seeded fault-injection plan: a JSON file "
+                        '({"seed": N, "faults": [{"kind": "delay|error|'
+                        'wedge|drop|truncate|slow_stream", "target": '
+                        '"prefill|decode:0|*", "endpoint": "/generate", '
+                        '"p": 0.3, "count": 5}, ...]}) or the literal '
+                        "'default' for the stock soak plan "
+                        "(fleet/chaos.py). Faults inject at the replica "
+                        "HTTP fronts and the control plane's handoff "
+                        "legs, deterministically per seed")
+    slo_flags(f)  # declared objectives activate SLO accounting AND
+    # SLO-aware admission shedding on every in-process replica
     return p
 
 
@@ -477,11 +489,23 @@ def cmd_fleet(args) -> int:
     manual poking (curl the printed control-plane URL)."""
     from butterfly_tpu.fleet.harness import start_fleet
 
+    chaos = None
+    if getattr(args, "chaos", None):
+        from butterfly_tpu.fleet.chaos import ChaosPlan, default_plan
+        chaos = default_plan() if args.chaos == "default" \
+            else ChaosPlan.from_file(args.chaos)
+        print(f"[butterfly] chaos plan armed: {len(chaos.rules)} rules, "
+              f"seed {chaos.seed}", flush=True)
     print(f"[butterfly] starting local fleet {args.topology} "
           f"(tiny model, warming each replica)...", flush=True)
+    slo_ttft = getattr(args, "slo_ttft_ms", None)
+    slo_itl = getattr(args, "slo_itl_ms", None)
     fleet = start_fleet(args.topology, page_size=args.page_size,
                         max_batch=args.max_batch, max_seq=args.max_seq,
-                        disagg_threshold=args.disagg_threshold)
+                        disagg_threshold=args.disagg_threshold,
+                        chaos=chaos,
+                        slo_ttft_s=slo_ttft / 1e3 if slo_ttft else None,
+                        slo_itl_s=slo_itl / 1e3 if slo_itl else None)
     print(f"[butterfly] control plane: {fleet.url}  "
           f"(GET /fleet/state, POST /generate)", flush=True)
     for r in fleet.replicas:

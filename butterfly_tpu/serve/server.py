@@ -54,6 +54,13 @@ from typing import Optional
 from butterfly_tpu.obs.metrics import ThroughputWindow, render_prometheus
 
 
+class LockTimeout(RuntimeError):
+    """A handler-thread path timed out acquiring the serving lock (a
+    slow or hung tick holds it). Every HTTP path that can raise this
+    answers 503 + Retry-After instead of pinning the handler thread —
+    and the timeout is counted (server_lock_timeouts_total)."""
+
+
 class StopSequenceMatcher:
     """Incremental stop-sequence detection over streamed text.
 
@@ -133,6 +140,24 @@ class ServerState:
         self.throughput = ThroughputWindow()
         self.t_start = time.monotonic()
         self.error: str = ""               # set => serving is wedged: 503s
+        # lock-acquire timeouts are multi-writer (any handler thread),
+        # unlike the scheduler registry's single-writer instruments —
+        # guard the counter with its own tiny lock
+        self._c_lock_timeout = scheduler.registry.counter(
+            "server_lock_timeouts_total",
+            "HTTP paths that timed out acquiring the serving lock (a "
+            "slow or hung tick held it) and answered 503 + Retry-After "
+            "instead of pinning a handler thread")
+        self._mlock = threading.Lock()
+        # Admission tolerates a much longer lock wait than the
+        # read-only surfaces: the scheduler thread legitimately holds
+        # the lock for SECONDS when a tick compiles a fresh XLA shape
+        # (20-40s cold on TPU), and 503ing arrivals through a compile
+        # would turn every unwarmed bucket's first burst into spurious
+        # errors. A truly HUNG tick is caught by the heartbeat latch
+        # (which wedges the server and fails submit fast), so this
+        # bound is a backstop, not the primary hang defense.
+        self.submit_lock_timeout = 30.0
         self.thread = threading.Thread(target=self._loop, daemon=True)
         # Optional HeartbeatMonitor (obs/health.py): the scheduler
         # thread beats after every tick and runs the probe in-thread
@@ -166,11 +191,37 @@ class ServerState:
         # iteration (error check in _loop); a truly hung tick never
         # reaches it, but then its host state is frozen and 503s flow.
         self.error = f"heartbeat failed: {self.heartbeat.last_error}"
-        if self.lock.acquire(timeout=2.0):
+        if self.acquire_lock():
             try:
                 self.sched.abort_all()
             finally:
                 self.lock.release()
+
+    def acquire_lock(self, timeout: float = 2.0) -> bool:
+        """Bounded serving-lock acquire for handler/watchdog threads:
+        a hung tick may hold the lock forever, and no HTTP path may pin
+        its thread on it. False = timed out (counted); the HTTP paths
+        then answer 503 + Retry-After via LockTimeout."""
+        if self.lock.acquire(timeout=timeout):
+            return True
+        with self._mlock:
+            self._c_lock_timeout.inc()
+        return False
+
+    def _locked(self, timeout: float = 2.0):
+        """Context manager: bounded acquire or LockTimeout."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if not self.acquire_lock(timeout=timeout):
+                raise LockTimeout(
+                    "serving lock busy (slow or hung tick); retry")
+            try:
+                yield
+            finally:
+                self.lock.release()
+        return cm()
 
     # -- scheduler thread ----------------------------------------------------
 
@@ -214,7 +265,13 @@ class ServerState:
     # -- handler-thread API ---------------------------------------------------
 
     def submit(self, tokens, max_tokens, temperature, stop_token,
-               request_id=None):
+               request_id=None, priority="interactive", deadline_s=None):
+        """Admit one request. Returns (req, queue); (None, retry_after
+        float) when SLO-aware admission SHED it (predicted TTFT busts
+        the declared objective — the handler answers 429 with the
+        computed Retry-After); (None, None) when the waiting queue is
+        full. Raises LockTimeout when the serving lock is held by a
+        slow/hung tick."""
         q: queue.Queue = queue.Queue()
 
         def on_token(req, token):
@@ -223,23 +280,28 @@ class ServerState:
         def on_finish(req):
             q.put(None)  # completion sentinel (after the last on_token)
 
-        with self.lock:
+        with self._locked(timeout=self.submit_lock_timeout):
             # re-check under the lock: the heartbeat may have wedged the
             # server between the handler's check and this admission
             if self.error:
                 raise RuntimeError("server wedged: " + self.error)
+            retry_after = self.sched.shed_decision(len(tokens), priority)
+            if retry_after is not None:
+                return None, retry_after
             if len(self.sched.waiting) >= self.max_queue:
                 return None, None
             req = self.sched.submit(tokens, max_new_tokens=max_tokens,
                                     temperature=temperature,
                                     stop_token=stop_token,
                                     on_token=on_token, on_finish=on_finish,
-                                    request_id=request_id)
+                                    request_id=request_id,
+                                    priority=priority,
+                                    deadline_s=deadline_s)
         self.wake.set()
         return req, q
 
     def metrics_text(self) -> str:
-        with self.lock:
+        with self._locked():
             vals = self.sched.metrics()
         vals["tokens_per_sec"] = self.throughput.rate()
         vals["uptime_seconds"] = time.monotonic() - self.t_start
@@ -253,7 +315,7 @@ class ServerState:
         the pools (every decode/prefill dispatch donates them) while
         the export gather reads page bytes out."""
         from butterfly_tpu.fleet.kvtransfer import export_payload
-        with self.lock:
+        with self._locked():
             if self.error:
                 raise RuntimeError("server wedged: " + self.error)
             return export_payload(self.sched, hex_hashes)
@@ -263,10 +325,17 @@ class ServerState:
         import claims pages from the same free/evictable lists
         admissions allocate from."""
         from butterfly_tpu.fleet.kvtransfer import import_payload
-        with self.lock:
+        with self._locked():
             if self.error:
                 raise RuntimeError("server wedged: " + self.error)
             return import_payload(self.sched, payload)
+
+    def count_deadline(self, where: str) -> None:
+        """Handler-thread deadline accounting (requests 504ed before
+        they ever reached the scheduler): the scheduler's counter
+        family is single-writer, so go through the metrics lock."""
+        with self._mlock:
+            self.sched._c_deadline.labels(where).inc()
 
     def debug_requests(self, n: Optional[int] = None,
                        request_id: Optional[str] = None) -> dict:
@@ -349,7 +418,12 @@ def make_handler(state: ServerState):
             elif self.path.split("?")[0] == "/kv/pages":
                 self._handle_kv_export()
             elif self.path == "/metrics":
-                body = state.metrics_text().encode()
+                try:
+                    body = state.metrics_text().encode()
+                except LockTimeout as e:
+                    self._json(503, {"error": str(e)},
+                               headers={"Retry-After": "1"})
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -409,6 +483,9 @@ def make_handler(state: ServerState):
                 self._json(200, state.export_kv(hashes))
             except LookupError as e:  # no prefix registry on this replica
                 self._json(501, self._kv_err(str(e)))
+            except LockTimeout as e:  # tick holds the lock: back off
+                self._json(503, self._kv_err(str(e)),
+                           headers={"Retry-After": "1"})
             except RuntimeError as e:  # wedged
                 self._json(503, self._kv_err(str(e)))
 
@@ -437,6 +514,9 @@ def make_handler(state: ServerState):
                 # is the safety property — a mismatched import would
                 # alias garbage K/V under a valid-looking chain hash
                 self._json(409, self._kv_err(f"{e}"))
+            except LockTimeout as e:  # tick holds the lock: back off
+                self._json(503, self._kv_err(str(e)),
+                           headers={"Retry-After": "1"})
             except RuntimeError as e:  # wedged
                 self._json(503, self._kv_err(str(e)))
 
@@ -448,11 +528,16 @@ def make_handler(state: ServerState):
             return body
 
         def _parse_request(self, body: dict):
-            """Shared validation -> (tokens, max_tokens, temperature, stop).
+            """Shared validation -> (tokens, max_tokens, temperature,
+            stop, rid, priority, deadline_ms).
 
             Accepts our native schema and the OpenAI-completions field
             names (`prompt` may be a string OR a token-id list there;
-            `max_new_tokens` is accepted as a `max_tokens` alias)."""
+            `max_new_tokens` is accepted as a `max_tokens` alias).
+            `deadline_ms` (body) / `X-Deadline-Ms` (header, wins) is
+            the REMAINING latency budget at arrival — routers and the
+            fleet control plane decrement it per hop; `priority` /
+            `X-Priority` selects the admission class."""
             if "tokens" in body:
                 tokens = [int(t) for t in body["tokens"]]
             else:
@@ -483,12 +568,44 @@ def make_handler(state: ServerState):
                 or body.get("request_id")
             rid = str(rid)[:128] if rid is not None else None
             self._rid = rid  # echoed on the response (incl. SSE headers)
-            return tokens, max_tokens, temperature, stop, rid
+            priority = str(self.headers.get("X-Priority")
+                           or body.get("priority") or "interactive")
+            if priority not in ("interactive", "batch"):
+                raise ValueError(f"unknown priority {priority!r}: "
+                                 "expected 'interactive' or 'batch'")
+            dl = self.headers.get("X-Deadline-Ms")
+            if dl is None:
+                dl = body.get("deadline_ms")
+            deadline_ms = float(dl) if dl is not None else None
+            if deadline_ms is not None and not deadline_ms == deadline_ms:
+                raise ValueError("deadline_ms must be a number")  # NaN
+            return (tokens, max_tokens, temperature, stop, rid,
+                    priority, deadline_ms)
+
+        def _deadline_504(self, where: str, deadline_ms,
+                          elapsed_s: float, openai: bool,
+                          partial=None) -> None:
+            """The deadline-exceeded terminal response: 504 with enough
+            detail (where it died, elapsed vs budget) that a client or
+            the fleet trace can attribute the miss without guessing."""
+            detail = {"where": where,
+                      "deadline_ms": deadline_ms,
+                      "elapsed_ms": elapsed_s * 1e3}
+            if openai:
+                body = {"error": {"message": "deadline exceeded "
+                                             f"({where})",
+                                  "type": "timeout_error", **detail}}
+            else:
+                body = {"error": "deadline exceeded", **detail}
+                if partial is not None:
+                    body["partial_tokens"] = partial
+            self._json(504, body)
 
         def _admit(self, body: dict, openai: bool = False):
             """Parse + submit; handles every error response (in the
             OpenAI error-envelope shape when `openai`). Returns
-            (req, queue) or None if a response was already sent."""
+            (req, queue, deadline_ms) or None if a response was already
+            sent."""
             def err(code: int, msg: str, etype: str,
                     headers=None) -> None:
                 if openai:
@@ -499,19 +616,35 @@ def make_handler(state: ServerState):
                     self._json(code, {"error": msg}, headers=headers)
 
             try:
-                tokens, max_tokens, temperature, stop, rid = \
-                    self._parse_request(body)
+                (tokens, max_tokens, temperature, stop, rid, priority,
+                 deadline_ms) = self._parse_request(body)
             except (ValueError, TypeError, KeyError) as e:
                 err(400, str(e), "invalid_request_error")
                 return None
             if state.error:
                 err(503, "server wedged: " + state.error, "server_error")
                 return None
+            now = time.monotonic()
+            deadline_s = None
+            if deadline_ms is not None:
+                if deadline_ms <= 0:
+                    # arrived already expired: terminal 504, never a
+                    # queue slot (the scheduler would only scrub it)
+                    state.count_deadline("admission")
+                    self._deadline_504("admission", deadline_ms, 0.0,
+                                       openai)
+                    return None
+                deadline_s = now + deadline_ms / 1e3
             try:
                 req, q = state.submit(tokens, max_tokens, temperature, stop,
-                                      request_id=rid)
+                                      request_id=rid, priority=priority,
+                                      deadline_s=deadline_s)
             except ValueError as e:  # can never fit the page pool
                 err(400, str(e), "invalid_request_error")
+                return None
+            except LockTimeout as e:  # slow/hung tick holds the lock
+                err(503, str(e), "server_error",
+                    headers={"Retry-After": "1"})
                 return None
             except RuntimeError as e:  # wedged while we were admitting
                 err(503, str(e), "server_error")
@@ -519,17 +652,25 @@ def make_handler(state: ServerState):
             if req is None:
                 # explicit backoff signal: the router (and well-behaved
                 # clients) should stop hammering a saturated replica
-                # instead of retry-spinning on 429s
-                err(429, "queue full", "rate_limit_error",
-                    headers={"Retry-After": "1"})
+                # instead of retry-spinning on 429s. q carries the
+                # computed Retry-After when SLO-aware admission SHED
+                # the request (predicted TTFT busts the objective).
+                if q is not None:
+                    err(429, "shed: predicted TTFT exceeds the declared "
+                        "objective", "rate_limit_error",
+                        headers={"Retry-After": str(int(-(-q // 1)))})
+                else:
+                    err(429, "queue full", "rate_limit_error",
+                        headers={"Retry-After": "1"})
                 return None
-            return req, q
+            return req, q, deadline_ms
 
         def _cancel_request(self, req) -> None:
             """Best-effort cancel from a handler thread: a hung tick may
             hold the lock forever — leaking the request is better than
-            pinning this thread on acquire."""
-            if state.lock.acquire(timeout=2.0):
+            pinning this thread on acquire (the timeout is counted in
+            server_lock_timeouts_total either way)."""
+            if state.acquire_lock():
                 try:
                     state.sched.cancel(req)
                 finally:
@@ -575,7 +716,7 @@ def make_handler(state: ServerState):
             admitted = self._admit(body)
             if admitted is None:
                 return
-            req, q = admitted
+            req, q, deadline_ms = admitted
             if body.get("stream"):
                 self._stream(req, q, t0)
                 return
@@ -583,6 +724,13 @@ def make_handler(state: ServerState):
             if got is None:
                 return
             toks, aborted = got
+            if req.state == "expired":
+                # the scheduler scrubbed/cancelled it at the deadline:
+                # terminal 504 with where-it-died + elapsed detail
+                self._deadline_504(req.expired_where or "running",
+                                   deadline_ms, time.monotonic() - t0,
+                                   openai=False, partial=toks)
+                return
             if aborted:
                 self._json(503, {"error": "generation aborted: "
                                  + (state.error or "cancelled"),
@@ -626,10 +774,11 @@ def make_handler(state: ServerState):
             admitted = self._admit(body, openai=True)
             if admitted is None:
                 return
-            req, q = admitted
+            req, q, deadline_ms = admitted
             matcher = StopSequenceMatcher(stops) if stops else None
             meta = {"id": f"cmpl-{req.id}", "object": "text_completion",
                     "created": int(time.time()), "model": state.model_name}
+            t0 = time.monotonic()
             if body.get("stream"):
                 self._stream_completions(req, q, meta, matcher)
                 return
@@ -637,6 +786,11 @@ def make_handler(state: ServerState):
             if got is None:
                 return
             toks, aborted = got
+            if req.state == "expired":
+                self._deadline_504(req.expired_where or "running",
+                                   deadline_ms, time.monotonic() - t0,
+                                   openai=True)
+                return
             if aborted:
                 self._json(503, {"error": {
                     "message": "generation aborted: "
@@ -720,7 +874,14 @@ def make_handler(state: ServerState):
                     payload = render_token(tok)
                     if payload is not None:
                         chunk(f"data: {payload}\n\n".encode())
-                if (req.state == "cancelled" and not natural_cancel()) \
+                if req.state == "expired":
+                    # deadline fired mid-stream: terminal error event —
+                    # already-streamed tokens stand, the client learns
+                    # the stream died on its own latency budget
+                    err = render_error("deadline exceeded "
+                                       f"({req.expired_where or 'running'})")
+                    chunk(f"data: {err}\n\n".encode())
+                elif (req.state == "cancelled" and not natural_cancel()) \
                         or (state.error and not req.done):
                     err = render_error("generation aborted: "
                                        + (state.error or "cancelled"))

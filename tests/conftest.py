@@ -135,6 +135,12 @@ SLOW_TESTS = {
     "test_fleet_trace_merged_waterfall",
     "test_fleet_trace_direct_request_and_unknown_id",
     "test_fleet_metrics_rollup_sums_match_replicas",
+    # overload protection / chaos (ISSUE 8): the multi-engine scenarios
+    # (the fast tier keeps the chaos-plan determinism, breaker cycle,
+    # scheduler deadline/shed units, and the HTTP 504/429/503 surfaces)
+    "test_fleet_deadline_spent_at_arrival_is_504",
+    "test_chaos_soak_terminal_outcomes",
+    "test_preempt_prefers_batch_victim",
 }
 
 

@@ -1,0 +1,226 @@
+"""Overload protection + chaos harness units (ISSUE 8).
+
+Pure-host fast tier: the seeded fault plan's determinism and
+validation, the replica-pool circuit breaker's open/half-open/close
+cycle, and the outbound-HTTP-timeout hygiene check. The system-level
+chaos soak (faulted 2p2d fleet under loadgen) lives in test_fleet.py
+(slow tier); deadline/shed scheduler behavior in test_sched.py; the
+HTTP 504/429 surfaces in test_server.py.
+"""
+import re
+from pathlib import Path
+
+import pytest
+
+from butterfly_tpu.fleet.chaos import (
+    ChaosIdent, ChaosPlan, default_plan)
+from butterfly_tpu.router.pool import ReplicaPool
+
+
+# ---------------------------------------------------------------------------
+# chaos plan: determinism, validation, scoping
+# ---------------------------------------------------------------------------
+
+PLAN_SPEC = {"seed": 42, "faults": [
+    {"kind": "wedge", "target": "decode:0", "endpoint": "/generate",
+     "p": 0.5, "count": 5},
+    {"kind": "delay", "target": "*", "p": 0.25, "count": 10,
+     "delay_s": 0.01},
+]}
+
+
+def _replay(n=60):
+    """One deterministic call sequence against a fresh plan."""
+    plan = ChaosPlan.from_json(PLAN_SPEC)
+    idents = [ChaosIdent("h:1", "decode", 0), ChaosIdent("h:2", "prefill", 0)]
+    out = []
+    for i in range(n):
+        inj = plan.decide(idents[i % 2], "/generate")
+        out.append(None if inj is None else inj.kind)
+    return out, plan.total_injected
+
+
+def test_chaos_plan_deterministic():
+    """The acceptance property: same plan JSON + seed + call sequence
+    => byte-identical injection decisions (per-rule seeded streams)."""
+    a, na = _replay()
+    b, nb = _replay()
+    assert a == b and na == nb
+    assert na > 0 and any(k == "wedge" for k in a)
+    # a different seed produces a different decision sequence
+    other = ChaosPlan.from_json({**PLAN_SPEC, "seed": 43})
+    idents = [ChaosIdent("h:1", "decode", 0), ChaosIdent("h:2", "prefill", 0)]
+    c = [None if (inj := other.decide(idents[i % 2], "/generate")) is None
+         else inj.kind for i in range(60)]
+    assert c != a
+
+
+def test_chaos_rule_budget_and_matching():
+    plan = ChaosPlan([{"kind": "drop", "target": "decode", "p": 1.0,
+                       "count": 2}])
+    dec = ChaosIdent("h:1", "decode", 0)
+    pre = ChaosIdent("h:2", "prefill", 0)
+    assert plan.decide(pre, "/generate") is None      # role mismatch
+    assert plan.decide(dec, "/generate").kind == "drop"
+    assert plan.decide(dec, "/generate").kind == "drop"
+    assert plan.decide(dec, "/generate") is None      # budget spent
+    assert plan.total_injected == 2
+    assert plan.summary()["rules"][0]["injected"] == 2
+
+
+def test_chaos_star_never_matches_health():
+    """'*' endpoints must not wedge liveness probing — /health is only
+    chaos-able when a rule names it explicitly."""
+    plan = ChaosPlan([{"kind": "drop", "target": "*", "p": 1.0}])
+    ident = ChaosIdent("h:1", "decode", 0)
+    assert plan.decide(ident, "/health") is None
+    assert plan.decide(ident, "/generate") is not None
+    named = ChaosPlan([{"kind": "wedge", "target": "*",
+                        "endpoint": "/health", "p": 1.0}])
+    assert named.decide(ident, "/health").kind == "wedge"
+
+
+def test_chaos_ident_target_forms():
+    ident = ChaosIdent("10.0.0.1:8000", "prefill", 1)
+    for target in ("*", "prefill", "prefill:1", "10.0.0.1:8000"):
+        assert ident.matches(target), target
+    for target in ("decode", "prefill:0", "10.0.0.2:8000"):
+        assert not ident.matches(target), target
+
+
+def test_chaos_plan_validation():
+    with pytest.raises(ValueError, match="kind"):
+        ChaosPlan([{"kind": "explode"}])
+    with pytest.raises(ValueError, match="probability"):
+        ChaosPlan([{"kind": "drop", "p": 1.5}])
+    with pytest.raises(ValueError, match="count"):
+        ChaosPlan([{"kind": "drop", "count": 0}])
+    with pytest.raises(ValueError, match="scope"):
+        ChaosPlan([{"kind": "drop", "where": "everywhere"}])
+    with pytest.raises(ValueError, match="plan"):
+        ChaosPlan.from_json({"seed": 1})
+    assert len(default_plan().rules) >= 5
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: open / half-open / close at the pool level
+# ---------------------------------------------------------------------------
+
+def make_pool(**kw):
+    kw.setdefault("breaker_threshold", 3)
+    kw.setdefault("breaker_cooldown", 60.0)  # manual clock control
+    return ReplicaPool(["h:1", "h:2"], **kw)
+
+
+def test_breaker_open_half_open_close_cycle():
+    """The full wedged-replica cycle the docs/fleet.md failure matrix
+    describes: threshold consecutive leg failures open the breaker
+    (candidates skip the member while /health still answers), the
+    cooldown admits ONE half-open probe, and a successful probe fully
+    restores."""
+    pool = make_pool()
+    r = pool.replicas["h:1"]
+    # two failures: still closed, still a candidate
+    pool.note_leg_failure("h:1", "wedged")
+    pool.note_leg_failure("h:1", "wedged")
+    assert r.breaker == "closed"
+    assert {c.rid for c in pool.candidates()} == {"h:1", "h:2"}
+    # third consecutive failure: OPEN — skipped entirely
+    pool.note_leg_failure("h:1", "wedged")
+    assert r.breaker == "open" and r.breaker_opens == 1
+    assert {c.rid for c in pool.candidates()} == {"h:2"}
+    assert pool.breaker_opens_total() == 1
+    # cooldown elapses: half-open, exactly one probe admitted
+    r.breaker_next_probe_t = 0.0
+    assert {c.rid for c in pool.candidates()} == {"h:1", "h:2"}
+    assert r.breaker == "half_open"
+    # with the probe in flight, the member is withheld again
+    pool.note_dispatch("h:1")
+    assert {c.rid for c in pool.candidates()} == {"h:2"}
+    pool.note_done("h:1")
+    # probe succeeded: fully closed, failure count reset
+    pool.note_leg_ok("h:1")
+    assert r.breaker == "closed" and r.breaker_fails == 0
+    assert {c.rid for c in pool.candidates()} == {"h:1", "h:2"}
+    assert r.breaker_opens == 1  # no second transition
+
+
+def test_breaker_reopens_on_half_open_failure():
+    pool = make_pool()
+    r = pool.replicas["h:1"]
+    for _ in range(3):
+        pool.note_leg_failure("h:1")
+    assert r.breaker == "open"
+    r.breaker_next_probe_t = 0.0
+    pool.candidates()                     # open -> half_open
+    assert r.breaker == "half_open"
+    pool.note_leg_failure("h:1")          # one bad probe re-opens
+    assert r.breaker == "open" and r.breaker_opens == 2
+    assert {c.rid for c in pool.candidates()} == {"h:2"}
+
+
+def test_breaker_success_resets_consecutive_count():
+    """Interleaved successes keep the breaker closed — it opens on
+    CONSECUTIVE failures only."""
+    pool = make_pool()
+    for _ in range(10):
+        pool.note_leg_failure("h:1")
+        pool.note_leg_failure("h:1")
+        pool.note_leg_ok("h:1")
+    assert pool.replicas["h:1"].breaker == "closed"
+    assert pool.breaker_opens_total() == 0
+
+
+def test_breaker_open_tier_empties_candidates():
+    """While every member of a tier has an open breaker, the tier's
+    candidate list is empty — the control plane's _disagg_plan then
+    finds no prefill candidate and degrades to direct dispatch (the
+    planner requires both tiers routable)."""
+    pool = make_pool()
+    pool.replicas["h:1"].role = "prefill"
+    pool.replicas["h:2"].role = "decode"
+    for _ in range(3):
+        pool.note_leg_failure("h:1")
+    assert pool.candidates("prefill") == []
+    assert {c.rid for c in pool.candidates("decode")} == {"h:2"}
+    # breaker state is visible on the snapshot /fleet/state serves
+    snap = {s["replica"]: s for s in pool.snapshot()}
+    assert snap["h:1"]["breaker"] == "open"
+    assert snap["h:1"]["breaker_opens"] == 1
+    assert snap["h:2"]["breaker"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# hygiene: every outbound HTTP call carries an explicit timeout
+# ---------------------------------------------------------------------------
+
+def _call_spans(text, name):
+    """Yield the argument span of every `name(...)` call in `text`
+    (balanced-paren scan, enough for call sites in this codebase)."""
+    for m in re.finditer(re.escape(name) + r"\(", text):
+        depth, i = 1, m.end()
+        while i < len(text) and depth:
+            depth += {"(": 1, ")": -1}.get(text[i], 0)
+            i += 1
+        yield text[m.start():i]
+
+
+def test_every_outbound_http_call_has_timeout():
+    """A urlopen/HTTPConnection call without an explicit timeout waits
+    on the OS default (minutes to forever) — one wedged peer then pins
+    a thread invisibly. Every outbound call in the package and tools
+    must carry one (the stray urlopen(..., timeout=5.0) this rule
+    replaced is why fleet side channels now share probe_timeout)."""
+    root = Path(__file__).parent.parent
+    offenders = []
+    for base in ("butterfly_tpu", "tools"):
+        for path in sorted((root / base).rglob("*.py")):
+            text = path.read_text()
+            for name in ("urlopen", "HTTPConnection"):
+                for span in _call_spans(text, name):
+                    if "timeout" not in span:
+                        offenders.append(f"{path.relative_to(root)}: "
+                                         f"{span[:80]!r}")
+    assert not offenders, (
+        "outbound HTTP calls without an explicit timeout:\n"
+        + "\n".join(offenders))

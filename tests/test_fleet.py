@@ -358,6 +358,15 @@ def test_handoff_falls_back_when_prefill_tier_dies(shared_model):
                   "request_id": "fb-1"})
         assert "disaggregated" not in r and len(r["tokens"]) == 4
         assert fleet.state.fleet_counters()["disagg_fallbacks"] >= 1
+        # the dead leg was CLASSIFIED (ISSUE 8 satellite): a refused
+        # prefill leg lands in fleet_leg_failures_total{leg,kind},
+        # not a bare except bucket
+        assert fleet.state.fleet_counters()["leg_failures"] >= 1
+        kinds = {k: c.value for k, c in
+                 fleet.state._c_leg_fail._children.items()}
+        assert kinds.get(("prefill_leg", "refused"), 0) >= 1, kinds
+        # and the leg failure fed the replica's circuit breaker
+        assert fleet.state.pool.get(pre.rid).breaker_fails >= 1
         ref = make_sched(shared_model)
         rr = ref.submit(prompt, max_new_tokens=4, stop_token=-1)
         ref.run_until_done()
@@ -374,6 +383,57 @@ def test_handoff_falls_back_when_prefill_tier_dies(shared_model):
         assert tr["sources"][dec_rid]["events"] > 0
     finally:
         fleet.stop()
+
+
+def test_fleet_deadline_spent_at_arrival_is_504(fleet_1p1d):
+    """A request whose deadline budget is already spent 504s at the
+    control plane — no classify, no handoff, no replica ever sees it —
+    with where/elapsed detail and the fleet counter ticked."""
+    before = fleet_1p1d.state.fleet_counters()["deadline_expired"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(fleet_1p1d.url, "/generate",
+             {"tokens": list(range(1, 40)), "max_tokens": 4,
+              "stop_token": -1, "deadline_ms": 0,
+              "request_id": "dl-arrival-1"})
+    assert e.value.code == 504
+    body = json.loads(e.value.read())
+    assert body["error"] == "deadline exceeded"
+    assert body["where"] == "arrival"
+    assert body["request_id"] == "dl-arrival-1"
+    after = fleet_1p1d.state.fleet_counters()["deadline_expired"]
+    assert after == before + 1
+    # a generous budget rides the handoff end to end untouched
+    r = post(fleet_1p1d.url, "/generate",
+             {"tokens": list(range(1, 40)), "max_tokens": 4,
+              "stop_token": -1, "deadline_ms": 120_000})
+    assert len(r["tokens"]) == 4
+
+
+def test_chaos_soak_terminal_outcomes():
+    """The ISSUE 8 acceptance soak: a 2p2d fleet under the SEEDED stock
+    fault plan (delays, 500s, a wedge burst, drops, truncations, a
+    dropped control-plane leg) driven by loadgen, plus a spent-deadline
+    burst. Every submitted request reaches a terminal outcome (tokens,
+    429, or 504): zero un-started drops, zero client hangs, zero
+    5xx-shaped errors — and the bench JSON carries the
+    overload-protection counter fields."""
+    from butterfly_tpu.obs.benchmark import run_chaos_benchmark
+    out = run_chaos_benchmark("2p2d", clients=3, requests_per_client=4)
+    assert out["chaos_requests"] == 15  # 12 chaos load + 3 expired burst
+    assert out["chaos_terminal"] == out["chaos_requests"]
+    assert out["chaos_unterminal"] == 0
+    assert out["chaos_errors"] == 0
+    # the faults actually fired (seeded plan, not a quiet pass) and the
+    # handoff degraded through its real fallback paths
+    assert out["chaos_injected"] > 0
+    assert out["chaos_leg_failures"] > 0
+    # the spent-budget burst died at the control plane as terminal 504s
+    assert out["chaos_deadline_504"] == 3
+    assert out["deadline_expired_total"] >= 3
+    # the acceptance bench keys exist (values are workload-dependent)
+    for key in ("serving_shed_total", "deadline_expired_total",
+                "breaker_open_total"):
+        assert key in out
 
 
 # ---------------------------------------------------------------------------

@@ -789,3 +789,116 @@ def test_written_counts_undrained_first_token():
     # once drained, the last sampled token's K/V is indeed unwritten
     assert sched._written(req) == len(req.all_tokens) - 1
     sched.run_until_done()
+
+
+# ---------------------------------------------------------------------------
+# overload protection (ISSUE 8): deadlines, SLO-aware shedding, priorities
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_while_waiting():
+    """An already-expired waiter is scrubbed from the queue at the next
+    tick: no slot, no prefill, state 'expired', counted under
+    where='waiting', and its on_finish waiter is answered."""
+    import time
+    sched, _ = make_sched()
+    fired = []
+    live = sched.submit([1, 2], max_new_tokens=2)
+    dead = sched.submit([3, 4], max_new_tokens=2,
+                        deadline_s=time.monotonic() - 0.01,
+                        on_finish=lambda r: fired.append(r.id))
+    sched.run_until_done()
+    assert dead.state == "expired" and dead.expired_where == "waiting"
+    assert dead.slot is None and dead.output == []
+    assert fired == [dead.id]
+    assert live.state == "finished" and len(live.output) == 2
+    m = sched.metrics()
+    assert m["deadline_expired_total"] == 1
+    assert 'butterfly_deadline_expired_total{where="waiting"} 1' \
+        in sched.registry.render()
+
+
+def test_deadline_expired_while_running():
+    """The acceptance hazard: a deadline firing mid-generation must
+    cancel the request out of its decode slot at the next drain
+    barrier — it never consumes a decode dispatch after expiry — while
+    a co-running request decodes on unharmed."""
+    import time
+    sched, params = make_sched(max_batch=2)
+    doomed = sched.submit([5, 7, 11], max_new_tokens=50)
+    ok = sched.submit([3, 1], max_new_tokens=8)
+    sched.tick()
+    assert doomed.state == "running"
+    doomed.deadline_s = time.monotonic() - 1e-3  # fires before next tick
+    sched.tick()
+    assert doomed.state == "expired" and doomed.expired_where == "running"
+    assert doomed.slot is None
+    n_at_expiry = len(doomed.output)
+    sched.run_until_done()
+    assert len(doomed.output) == n_at_expiry  # zero decode steps after
+    assert ok.state == "finished"
+    assert ok.output == ref_tokens(params, [3, 1], 8)
+    assert sched.metrics()["deadline_expired_total"] == 1
+    # the freed slot + pages are fully reclaimed
+    assert sched.alloc.free_pages == sched.alloc.num_pages
+
+
+def test_shed_batch_before_interactive():
+    """SLO-aware admission sheds by priority class: with a predicted
+    TTFT between the objective and interactive_slack x it, batch is
+    turned away (429 + computed Retry-After) while interactive still
+    admits. Without evidence or without a declared objective, nothing
+    sheds."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+    engine = ServingEngine(model, params, rt)
+    sched = Scheduler(engine, slo_ttft_s=0.5)
+    # no latency evidence yet: a cold server never sheds blind
+    assert sched.shed_decision(32, "batch") is None
+    # seed the rolling ITL window + a queue: predict_ttft becomes
+    # rounds * mean_itl with rounds = ceil(backlog/prefill_chunk)
+    # + len(waiting)  ->  0.1 * (1 + 6) = 0.7s for a 32-token prompt
+    sched._itl_means.extend([0.1] * 8)
+    for _ in range(6):
+        sched.submit([1] * 30, max_new_tokens=2)
+    pred = sched.predict_ttft(32)
+    assert 0.5 < pred <= 1.0, pred  # between slo and 2x slo
+    retry = sched.shed_decision(32, "batch")
+    assert retry is not None and retry >= 1.0
+    assert sched.shed_decision(32, "interactive") is None
+    m = sched.metrics()
+    assert m["shed_total"] == 1
+    assert 'butterfly_shed_total{priority="batch"} 1' \
+        in sched.registry.render()
+    # no declared objective -> the same pressure never sheds
+    off = Scheduler(engine)
+    off._itl_means.extend([0.1] * 8)
+    for _ in range(6):
+        off.submit([1] * 30, max_new_tokens=2)
+    assert off.shed_decision(32, "batch") is None
+    sched.run_until_done()
+    off.run_until_done()
+
+
+def test_preempt_prefers_batch_victim():
+    """Under page pressure the preemption victim is batch-first, then
+    youngest: an OLDER batch request recomputes so a younger
+    interactive one keeps its pages (both still finish correctly)."""
+    sched, params = make_sched(max_batch=2, max_seq=32, page=4,
+                               num_pages=6)
+    batch = sched.submit([5, 7, 11], max_new_tokens=10, priority="batch")
+    sched.tick()
+    inter = sched.submit([3, 1], max_new_tokens=10)  # younger, interactive
+    sched.run_until_done(max_ticks=300)
+    assert batch.state == "finished" and inter.state == "finished"
+    assert batch.preemptions > 0       # older but batch: the victim
+    assert inter.preemptions == 0
+    assert batch.output == ref_tokens(params, [5, 7, 11], 10)
+    assert inter.output == ref_tokens(params, [3, 1], 10)
+
+
+def test_submit_rejects_unknown_priority():
+    import pytest
+    sched, _ = make_sched()
+    with pytest.raises(ValueError, match="priority"):
+        sched.submit([1], max_new_tokens=2, priority="best-effort")

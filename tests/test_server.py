@@ -441,3 +441,90 @@ def test_openai_error_envelope_from_admit_path(server):
     with pytest.raises(urllib.error.HTTPError) as e2:
         post(server, "/generate", {"tokens": [999999], "max_tokens": 2})
     assert json.loads(e2.value.read())["error"] == "token id out of range"
+
+
+# ---------------------------------------------------------------------------
+# overload protection (ISSUE 8): deadline 504s, priorities, lock timeouts
+# ---------------------------------------------------------------------------
+
+def test_spent_deadline_is_504_at_admission(server):
+    """A request arriving with its budget already spent gets a terminal
+    504 with where/elapsed detail — it never touches the queue."""
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/generate",
+             {"tokens": [1, 2], "max_tokens": 2, "deadline_ms": 0})
+    assert e.value.code == 504
+    body = json.loads(e.value.read())
+    assert body["error"] == "deadline exceeded"
+    assert body["where"] == "admission"
+    assert "elapsed_ms" in body and body["deadline_ms"] == 0
+    # header form (X-Deadline-Ms) wins and takes the same path; the
+    # OpenAI endpoint answers in its error envelope
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 2}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Deadline-Ms": "-5"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 504
+    env = json.loads(e.value.read())["error"]
+    assert env["type"] == "timeout_error" and env["where"] == "admission"
+    # counted (handler-side: the scheduler never saw the request)
+    text = get(server, "/metrics")
+    assert 'butterfly_deadline_expired_total{where="admission"}' in text
+
+
+def test_generous_deadline_serves_normally(server):
+    out = post(server, "/generate",
+               {"tokens": [5, 7], "max_tokens": 3, "stop_token": -1,
+                "deadline_ms": 120_000, "priority": "batch"})
+    assert len(out["tokens"]) == 3
+
+
+def test_unknown_priority_is_400(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/generate",
+             {"tokens": [1], "max_tokens": 2, "priority": "urgent"})
+    assert e.value.code == 400
+    assert "priority" in json.loads(e.value.read())["error"]
+
+
+def test_lock_timeout_answers_503_with_retry_after():
+    """A held serving lock (slow/hung tick) must not pin handler
+    threads: bounded acquire, 503 + Retry-After, and the timeout is
+    counted. Uses a local server whose lock the test holds."""
+    from http.server import ThreadingHTTPServer
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(0))
+    rt = RuntimeConfig(max_batch_size=1, max_seq_len=64, page_size=8)
+    sched = Scheduler(ServingEngine(model, params, rt))
+    state = ServerState(sched, ByteTokenizer())
+    # scheduler loop deliberately NOT started: the lock stays ours.
+    # Admission tolerates compile-length waits in production (30s);
+    # shrink it so the test observes the timeout without the wait.
+    state.submit_lock_timeout = 0.5
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    state.lock.acquire()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/metrics", timeout=30)
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") == "1"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(url, "/generate", {"tokens": [1], "max_tokens": 2})
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") == "1"
+        assert sched.registry.get(
+            "server_lock_timeouts_total").value == 2
+    finally:
+        state.lock.release()
+        httpd.shutdown()
+        httpd.server_close()
+    # with the lock free again the same surfaces answer normally
+    # (no scheduler thread ran: only the lock-free paths are probed)
+    assert "butterfly_server_lock_timeouts_total 2" \
+        in state.metrics_text()

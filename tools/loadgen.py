@@ -68,7 +68,9 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
              vocab: int = 64, path: str = "/generate",
              timeout: float = 120.0,
              slo_ttft_ms: Optional[float] = None,
-             slo_itl_ms: Optional[float] = None) -> Dict:
+             slo_itl_ms: Optional[float] = None,
+             deadline_ms: Optional[float] = None,
+             priority: Optional[str] = None) -> Dict:
     """Drive `url` closed-loop; returns aggregate stats.
 
     Every request uses token-id prompts (deterministic, tokenizer-free).
@@ -82,7 +84,14 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     summary carries `slo_attainment`, the fraction of OK responses that
     met every declared objective (a response missing the fields it
     needs counts as a miss: the client couldn't verify its SLO).
-    """
+
+    `deadline_ms` stamps a latency budget on every request (the server
+    504s whatever blows it); `priority` tags the admission class
+    ('interactive'/'batch'; batch sheds first under load). The summary's
+    `outcomes` dict is the TERMINAL-OUTCOME breakdown — ok / shed_429 /
+    deadline_504 / error — so a soak shows shedding and expiry instead
+    of hiding them inside `failed`; `terminal` counts requests that got
+    ANY definitive answer (everything but transport errors/hangs)."""
     prefix = shared_prefix(shared_len, seed, vocab)
     lock = threading.Lock()
     latencies: List[float] = []
@@ -92,6 +101,7 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     errors: List[str] = []
     counts = {"sent": 0, "ok": 0, "shared": 0, "disaggregated": 0,
               "slo_ok": 0, "slo_ttft_ok": 0, "slo_itl_ok": 0}
+    outcomes = {"ok": 0, "shed_429": 0, "deadline_504": 0, "error": 0}
     slo_declared = slo_ttft_ms is not None or slo_itl_ms is not None
 
     def one_client(cid: int) -> None:
@@ -102,10 +112,15 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
             tokens = (prefix + tail) if is_shared else \
                 [rng.randrange(1, vocab)
                  for _ in range(shared_len + tail_len)]
-            body = json.dumps({
+            payload = {
                 "tokens": tokens, "max_tokens": max_tokens,
                 "stop_token": -1,
-                "request_id": f"loadgen-{cid}-{i}"}).encode()
+                "request_id": f"loadgen-{cid}-{i}"}
+            if deadline_ms is not None:
+                payload["deadline_ms"] = deadline_ms
+            if priority is not None:
+                payload["priority"] = priority
+            body = json.dumps(payload).encode()
             req = urllib.request.Request(
                 url + path, data=body,
                 headers={"Content-Type": "application/json"})
@@ -141,6 +156,7 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
                 with lock:
                     counts["sent"] += 1
                     counts["ok"] += 1
+                    outcomes["ok"] += 1
                     counts["shared"] += int(is_shared)
                     counts["disaggregated"] += int(disagg)
                     if slo_declared:
@@ -154,9 +170,28 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
                         shared_latencies.append(dt)
                     if routed:
                         by_replica[routed] = by_replica.get(routed, 0) + 1
+            except urllib.error.HTTPError as e:
+                # an HTTP error IS a terminal outcome: the server
+                # answered definitively. 429 = shed/backpressure,
+                # 504 = deadline exceeded; anything else is a fault.
+                try:
+                    e.read()
+                except OSError:
+                    pass
+                e.close()
+                with lock:
+                    counts["sent"] += 1
+                    if e.code == 429:
+                        outcomes["shed_429"] += 1
+                    elif e.code == 504:
+                        outcomes["deadline_504"] += 1
+                    else:
+                        outcomes["error"] += 1
+                        errors.append(f"client{cid}#{i}: http {e.code}")
             except (urllib.error.URLError, OSError) as e:
                 with lock:
                     counts["sent"] += 1
+                    outcomes["error"] += 1
                     errors.append(f"client{cid}#{i}: {e}")
 
     t_start = time.monotonic()
@@ -170,6 +205,13 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     return {
         "sent": counts["sent"], "ok": counts["ok"],
         "failed": counts["sent"] - counts["ok"],
+        # terminal-outcome breakdown: every sent request lands in
+        # exactly one bucket; `terminal` excludes only transport
+        # errors/hangs — the chaos soak's zero-hang property is
+        # terminal == sent with outcomes["error"] == 0
+        "outcomes": dict(outcomes),
+        "terminal": outcomes["ok"] + outcomes["shed_429"]
+                    + outcomes["deadline_504"],
         "shared_prefix_requests": counts["shared"],
         "disaggregated": counts["disaggregated"],
         "wall_s": wall,
@@ -225,7 +267,9 @@ def run_fleet_soak(url: str, clients: int = 4,
                    replicas: Optional[List[str]] = None,
                    restart_hook=None, settle_s: float = 0.3,
                    slo_ttft_ms: Optional[float] = None,
-                   slo_itl_ms: Optional[float] = None) -> Dict:
+                   slo_itl_ms: Optional[float] = None,
+                   deadline_ms: Optional[float] = None,
+                   priority: Optional[str] = None) -> Dict:
     """Fleet soak: closed-loop load against a control plane WHILE every
     replica is rolled through drain -> (restart) -> undrain, one at a
     time. The pass/fail property is the router tier's: zero dropped
@@ -251,7 +295,8 @@ def run_fleet_soak(url: str, clients: int = 4,
             prefix_share=prefix_share, shared_len=shared_len,
             tail_len=tail_len, max_tokens=max_tokens, seed=seed,
             vocab=vocab, timeout=timeout, slo_ttft_ms=slo_ttft_ms,
-            slo_itl_ms=slo_itl_ms))
+            slo_itl_ms=slo_itl_ms, deadline_ms=deadline_ms,
+            priority=priority))
 
     t = threading.Thread(target=_load)
     t.start()
@@ -299,6 +344,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--slo-itl-ms", type=float, default=None,
                     help="declared mean inter-token-latency objective "
                          "(per request), judged client-side")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="stamp this latency budget (deadline_ms) on "
+                         "every request; the server answers 504 for "
+                         "whatever blows it — the summary's outcomes "
+                         "dict shows the deadline_504 count")
+    ap.add_argument("--priority", choices=["interactive", "batch"],
+                    default=None,
+                    help="admission class tag: 'batch' is shed first "
+                         "when SLO-aware admission is active")
     ap.add_argument("--soak", action="store_true",
                     help="fleet soak mode: roll every replica through "
                          "drain/undrain (discovered via "
@@ -316,7 +370,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                tail_len=args.tail_len,
                                max_tokens=args.max_tokens, seed=args.seed,
                                slo_ttft_ms=args.slo_ttft_ms,
-                               slo_itl_ms=args.slo_itl_ms)
+                               slo_itl_ms=args.slo_itl_ms,
+                               deadline_ms=args.deadline_ms,
+                               priority=args.priority)
     else:
         stats = run_load(args.url, clients=args.clients,
                          requests_per_client=args.requests,
@@ -324,12 +380,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          shared_len=args.shared_len, tail_len=args.tail_len,
                          max_tokens=args.max_tokens, seed=args.seed,
                          path=args.path, slo_ttft_ms=args.slo_ttft_ms,
-                         slo_itl_ms=args.slo_itl_ms)
+                         slo_itl_ms=args.slo_itl_ms,
+                         deadline_ms=args.deadline_ms,
+                         priority=args.priority)
     if args.json:
         print(json.dumps(stats, indent=2))
     else:
         print(f"sent={stats['sent']} ok={stats['ok']} "
               f"failed={stats['failed']} rps={stats['rps']:.2f}")
+        o = stats["outcomes"]
+        print(f"outcomes: ok={o['ok']} shed_429={o['shed_429']} "
+              f"deadline_504={o['deadline_504']} error={o['error']} "
+              f"(terminal {stats['terminal']}/{stats['sent']})")
         print(f"latency p50={stats['latency_p50_s'] * 1e3:.1f}ms "
               f"p95={stats['latency_p95_s'] * 1e3:.1f}ms")
         if stats.get("slo_attainment") is not None:
@@ -342,7 +404,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 sorted(stats["by_replica"].items())))
         for e in stats["errors"]:
             print(f"error: {e}", file=sys.stderr)
-    return 0 if stats["failed"] == 0 else 1
+    # sheds and deadline 504s are terminal outcomes the run ASKED for
+    # (backpressure working as designed) — only transport errors/hangs
+    # and 5xx faults fail the run
+    return 0 if stats["outcomes"]["error"] == 0 else 1
 
 
 if __name__ == "__main__":
