@@ -28,7 +28,8 @@ def main() -> int:
     from butterfly_tpu.obs.benchmark import (run_chaos_benchmark,
                                              run_decode_benchmark,
                                              run_fleet_benchmark,
-                                             run_serving_benchmark)
+                                             run_serving_benchmark,
+                                             run_spec_benchmark)
     from butterfly_tpu.quant.int8 import init_params_quantized
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -104,6 +105,21 @@ def main() -> int:
               "serving_capacity_tokens_per_sec", "serving_gap"):
         if k in serving_sync:
             serving[k + "_sync"] = serving_sync[k]
+    # Speculation phase (ISSUE 9): spec-on vs spec-off tok/s at the
+    # round's operating point plus the speculation instruments —
+    # spec_tokens_per_forward (> 1 = drafts landing), the accept rate,
+    # and drain barriers per verify round (~0 = the spec rounds really
+    # pipeline instead of barriering like the old host accept loop).
+    # Draft-friendly workload (prompts seeded with the model's own
+    # greedy continuation) so prompt lookup has something to mine.
+    spec_kw = dict(n_requests=serving_kw["n_requests"],
+                   prompt_len=serving_kw["prompt_len"],
+                   max_new=serving_kw["max_new"],
+                   max_batch=serving_kw["max_batch"],
+                   decode_steps_per_tick=serving_kw["decode_steps_per_tick"],
+                   gamma=4)
+    serving.update(run_spec_benchmark(
+        model, params, kv_quant=kv_quant, **spec_kw))
     toks_per_sec_chip = stats["tokens_per_sec_per_chip"]
 
     vs = 1.0

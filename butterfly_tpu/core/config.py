@@ -223,13 +223,28 @@ class RuntimeConfig:
                                       # writes; 0 = auto (16 with an
                                       # int8 cache — measured best on
                                       # v5e — else 1)
-    speculative_gamma: int = 0        # serving-path prompt-lookup
-                                      # speculative decoding: draft this
-                                      # many tokens per slot and verify
-                                      # them in ONE batched forward.
-                                      # Greedy-only (submit rejects
-                                      # temperature > 0). 0 = off
+    speculative_gamma: int = 0        # serving-path speculative
+                                      # decoding: draft this many
+                                      # tokens per slot per round and
+                                      # verify ALL slots in one batched
+                                      # (gamma+1)-token forward, with
+                                      # accept/rollback computed on
+                                      # device inside the fused spec
+                                      # block (engine._spec_scan).
+                                      # Sampling-safe: temperature /
+                                      # top-k / top-p requests get the
+                                      # exact rejection-sampling
+                                      # correction. 0 = off
     speculative_ngram: int = 2        # lookup ngram for the drafts
+    draft_model: str = "ngram"        # draft source for the spec block:
+                                      # "ngram" = model-free prompt
+                                      # lookup over the device-side
+                                      # token history; a small
+                                      # on-device draft model plugs in
+                                      # via engine.serving.
+                                      # register_draft_source (a jax
+                                      # callable traced inside the
+                                      # jitted spec scan)
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

@@ -49,11 +49,13 @@ class SpeculativeResult:
 
 
 def _accept_drafts(draft, greedy) -> List[int]:
-    """Greedy draft acceptance shared by generate_speculative and the
-    serving scheduler's _spec_step (their semantics must not drift):
-    emit greedy[0] (the token after `cur`), then keep accepting while
-    draft[i] == greedy[i], each acceptance also emitting greedy[i+1].
-    Token-for-token identical to plain greedy decode by construction."""
+    """Greedy draft acceptance — the host fast path of the shared
+    semantics sampling.speculative_accept implements on device for the
+    serving spec block (the two must not drift; the temp-0 rows of the
+    device kernel reproduce exactly this): emit greedy[0] (the token
+    after `cur`), then keep accepting while draft[i] == greedy[i], each
+    acceptance also emitting greedy[i+1]. Token-for-token identical to
+    plain greedy decode by construction."""
     emitted = [int(greedy[0])]
     for i, d in enumerate(draft):
         if d != int(greedy[i]):
@@ -376,17 +378,23 @@ class InferenceEngine:
 
     def generate_speculative(self, prompt: Sequence[int],
                              sp: Optional[SamplingParams] = None,
-                             gamma: int = 4, ngram: int = 2
-                             ) -> "SpeculativeResult":
-        """Greedy generation with prompt-lookup speculative decoding.
+                             gamma: int = 4, ngram: int = 2,
+                             seed: int = 0) -> "SpeculativeResult":
+        """Generation with prompt-lookup speculative decoding.
 
         Drafts `gamma` tokens per step by matching the last `ngram`
         generated tokens against the sequence so far (the model-free
         "prompt lookup" scheme) and verifies the whole draft in ONE
         (gamma+1)-token warm forward. Accepted drafts advance the
-        sequence several tokens per forward; output is token-for-token
-        IDENTICAL to plain greedy decode — speculation only changes how
-        many forwards it takes, never what they produce.
+        sequence several tokens per forward. At temperature 0 the
+        output is token-for-token IDENTICAL to plain greedy decode
+        (`_accept_drafts` fast path); at temperature > 0 each draft is
+        accepted with probability p(draft) and the first rejection
+        resamples from the residual (sampling.speculative_accept — the
+        Leviathan et al. rejection-sampling correction, exact for the
+        one-hot prompt-lookup proposal), so the output DISTRIBUTION
+        equals plain sampling. Either way speculation only changes how
+        many forwards the tokens take.
 
         Correctness of the KV cache under rejection: a verify step
         writes K/V for every draft position; rejected positions hold
@@ -395,14 +403,11 @@ class InferenceEngine:
         that far (write-then-attend in attention_block), so stale
         entries are never visible.
 
-        Single-sequence, host-looped (per-row accept counts diverge, so
-        this is not batched); greedy only — stochastic speculative
-        sampling would need the rejection-sampling correction.
+        Single-sequence, host-looped (per-row accept counts diverge;
+        the BATCHED multi-slot edition lives in the serving engine's
+        spec block — engine/serving.py _spec_scan).
         """
         sp = sp or SamplingParams()
-        if not sp.is_greedy:
-            raise NotImplementedError(
-                "speculative decoding is greedy-only (temperature=0)")
         if gamma < 1 or ngram < 1:
             raise ValueError("gamma and ngram must be >= 1")
         if self.mesh is not None and (self.mesh.shape.get("data", 1) > 1
@@ -424,16 +429,24 @@ class InferenceEngine:
             from butterfly_tpu.parallel.partition import shard_cache
             cache = shard_cache(cache, self.cfg, self.mesh)
 
+        stochastic = not sp.is_greedy
+        key, first_key = jax.random.split(jax.random.PRNGKey(seed))
         with self._mesh_ctx():
             logits, cache = self.prefill(jnp.asarray(tokens),
                                          jnp.asarray(true_lens), cache)
-            cur = int(jnp.argmax(logits[0]))
+            cur = int(np.asarray(sample(logits, first_key, sp))[0]) \
+                if stochastic else int(jnp.argmax(logits[0]))
         history = list(prompt) + [cur]
         out = [cur]
         forwards = 1  # the prefill produced the first token
         accepted_total = 0
 
-        verify = self._verify_program(gamma)
+        # greedy keeps its argmax-on-device program (+_accept_drafts
+        # fast path, byte-identical to plain greedy decode); sampling
+        # fetches the verify logits and runs the rejection-sampling
+        # correction (the shared speculative_accept kernel)
+        verify = self._verify_program(gamma, logits=stochastic)
+        temps = jnp.asarray([sp.temperature], jnp.float32)
         while len(out) < sp.max_new_tokens and \
                 not (sp.stop_token >= 0 and out[-1] == sp.stop_token):
             draft = _ngram_draft(history, gamma, ngram)
@@ -441,11 +454,19 @@ class InferenceEngine:
             toks = jnp.asarray([[cur] + draft], jnp.int32)
             positions = pos0 + jnp.arange(gamma + 1)[None, :]
             with self._mesh_ctx():
-                greedy, cache = verify(self.params, toks, cache, positions)
-            greedy = np.asarray(greedy[0])  # [gamma+1]
+                ver, cache = verify(self.params, toks, cache, positions)
             forwards += 1
 
-            emitted = _accept_drafts(draft, greedy)
+            if stochastic:
+                from butterfly_tpu.engine.sampling import speculative_accept
+                key, sub = jax.random.split(key)
+                em, n_acc = speculative_accept(
+                    ver, jnp.asarray([draft], jnp.int32), sub, temps,
+                    sp.top_k, sp.top_p)
+                n = int(np.asarray(n_acc)[0]) + 1
+                emitted = np.asarray(em)[0, :n].tolist()
+            else:
+                emitted = _accept_drafts(draft, np.asarray(ver[0]))
             accepted_total += len(emitted) - 1
             # valid cache entries: cur + the accepted drafts
             new_len = pos0 + len(emitted)
@@ -465,20 +486,27 @@ class InferenceEngine:
             tokens=np.asarray(out, np.int32), forwards=forwards,
             accepted_drafts=accepted_total)
 
-    def _verify_program(self, gamma: int):
-        """jitted (gamma+1)-token warm verify: returns per-position
-        greedy next tokens [B, gamma+1]. Cached per gamma."""
+    def _verify_program(self, gamma: int, logits: bool = False):
+        """jitted (gamma+1)-token warm verify. Returns per-position
+        greedy next tokens [B, gamma+1] (logits=False — the greedy
+        fast path keeps argmax on device) or the raw per-position
+        logits [B, gamma+1, V] (logits=True — the stochastic path
+        feeds them to the rejection-sampling correction). Cached per
+        (gamma, flavor)."""
         if not hasattr(self, "_verify_cache"):
             self._verify_cache = {}
-        if gamma not in self._verify_cache:
+        cache_key = (gamma, logits)
+        if cache_key not in self._verify_cache:
             fwd = self._fwd
 
-            def step(params, toks, cache, positions):
-                logits, cache = fwd(params, toks, cache, positions)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            def step(params, toks, cache, positions, _logits=logits):
+                out, cache = fwd(params, toks, cache, positions)
+                if not _logits:
+                    out = jnp.argmax(out, axis=-1).astype(jnp.int32)
+                return out, cache
 
-            self._verify_cache[gamma] = jax.jit(step, donate_argnums=(2,))
-        return self._verify_cache[gamma]
+            self._verify_cache[cache_key] = jax.jit(step, donate_argnums=(2,))
+        return self._verify_cache[cache_key]
 
     def _mesh_ctx(self):
         import contextlib

@@ -30,6 +30,97 @@ def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
+def _filter_logits(scaled: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """The temperature-scaled logits after the static top-k/top-p
+    filters — the distribution every sampling path (plain decode,
+    speculative accept, residual resample) must agree on."""
+    if top_k > 0:
+        scaled = _apply_top_k(scaled, top_k)
+    if top_p < 1.0:
+        scaled = _apply_top_p(scaled, top_p)
+    return scaled
+
+
+def speculative_accept(logits: jax.Array, drafts: jax.Array, key: jax.Array,
+                       temps: jax.Array, top_k: int, top_p: float,
+                       spec_mask: jax.Array = None):
+    """Batched draft acceptance with the rejection-sampling correction
+    (Leviathan et al. 2023), for DETERMINISTIC drafts (prompt-lookup /
+    greedy draft models — the proposal q is a point mass at the draft).
+
+    logits [S, C, V] are a verify forward's per-position target logits
+    (C = gamma + 1: position i is the next-token distribution after the
+    i-th context token); drafts [S, gamma] the proposed tokens; temps
+    [S] per-slot temperatures (0 = greedy row).
+
+    Per position i the target distribution p_i is EXACTLY the one plain
+    decode samples from (temperature-scaled, top-k/top-p filtered —
+    _filter_logits). With a one-hot proposal q, accept-with-prob
+    min(1, p/q) reduces to: accept draft d_i with probability p_i(d_i);
+    on the first rejection resample from the residual (p - q)+ — p_i
+    with d_i masked out, renormalized — and when every draft is
+    accepted, sample one bonus token from p_gamma. Total emitted per
+    slot: n_acc + 1 tokens whose joint law equals autoregressive
+    sampling from p — speculation changes how many forwards the tokens
+    take, never their distribution. Greedy rows (temp 0) take the
+    `_accept_drafts` fast path semantics instead: accept while
+    d_i == argmax_i, emit the argmax at the first mismatch — output
+    byte-identical to plain greedy decode.
+
+    p_i(d_i) == 1 (the draft is the whole filtered nucleus) always
+    accepts (u ~ U[0,1) < 1), so the degenerate all--inf residual row
+    is never selected.
+
+    `spec_mask` [S] bool (None = all true): rows with False ignore
+    their drafts entirely — n_acc is forced to 0 AND the emitted token
+    comes from the FULL distribution, not the residual (no accept test
+    ran, so a residual resample would be biased away from the draft).
+    This is the per-request speculation opt-out: such a slot emits one
+    exact plain-decode sample per verify round.
+
+    Returns (emitted [S, C], n_acc [S]): emitted[:, :n_acc] are the
+    accepted drafts, emitted[:, n_acc] the correction/bonus sample;
+    entries past n_acc are padding. Pure jax — usable inside a jitted
+    scan (the serving spec block) or eagerly (generate_speculative).
+    """
+    S, C, V = logits.shape
+    gamma = C - 1
+    if spec_mask is None:
+        spec_mask = jnp.ones((S,), bool)
+    stochastic = (temps > 0)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, C]
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None, None]
+    scaled = _filter_logits(logits / safe_t, top_k, top_p)
+    ku, kr = jax.random.split(key)
+    if gamma > 0:
+        probs = jax.nn.softmax(scaled[:, :gamma, :], axis=-1)
+        p_draft = jnp.take_along_axis(
+            probs, drafts[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        u = jax.random.uniform(ku, (S, gamma))
+        accept = jnp.where(stochastic[:, None], u < p_draft,
+                           drafts == greedy_tok[:, :gamma])
+        accept = accept & spec_mask[:, None]
+        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1).astype(jnp.int32)
+        # residual: position i with the TESTED-and-rejected draft masked
+        # out; opt-out rows never tested, so their distribution stays full
+        one_hot = jax.nn.one_hot(drafts, V, dtype=bool)
+        resid = jnp.where(one_hot & spec_mask[:, None, None], -jnp.inf,
+                          scaled[:, :gamma, :])
+        corr_logits = jnp.concatenate([resid, scaled[:, gamma:, :]], axis=1)
+        pad_drafts = jnp.concatenate(
+            [drafts.astype(jnp.int32), jnp.zeros((S, 1), jnp.int32)], axis=1)
+    else:
+        n_acc = jnp.zeros((S,), jnp.int32)
+        corr_logits = scaled
+        pad_drafts = jnp.zeros((S, C), jnp.int32)
+    drawn = jax.random.categorical(kr, corr_logits, axis=-1).astype(jnp.int32)
+    corr = jnp.where(stochastic[:, None], drawn, greedy_tok)
+    emitted = jnp.where(jnp.arange(C)[None, :] < n_acc[:, None],
+                        pad_drafts, corr)
+    return emitted, n_acc
+
+
 def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)

@@ -46,7 +46,8 @@ from jax import lax
 from butterfly_tpu.cache.paged import (
     PagedKVCache, init_paged_cache, paged_forward)
 from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
-from butterfly_tpu.engine.sampling import _apply_top_k, _apply_top_p
+from butterfly_tpu.engine.sampling import (
+    _filter_logits, speculative_accept)
 from butterfly_tpu.models.common import Model
 
 
@@ -87,13 +88,54 @@ def sample_batched(logits: jax.Array, key: jax.Array, temps: jax.Array,
     """Per-slot-temperature sampling: temp 0 rows are greedy. [S,V]->[S]."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
-    scaled = logits / safe_t
-    if top_k > 0:
-        scaled = _apply_top_k(scaled, top_k)
-    if top_p < 1.0:
-        scaled = _apply_top_p(scaled, top_p)
+    scaled = _filter_logits(logits / safe_t, top_k, top_p)
     drawn = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temps > 0, drawn, greedy)
+
+
+def _ngram_drafts(hist, hist_len, gamma: int, ngram: int) -> jax.Array:
+    """Prompt-lookup drafts for every slot, ON DEVICE — the batched twin
+    of engine._ngram_draft (their match rules must not drift): find the
+    most recent STRICTLY-EARLIER occurrence of each slot's trailing
+    `ngram` tokens in its history and propose the `gamma` tokens that
+    followed it, zero-padded where the continuation runs out or no
+    match exists (padding just gets rejected by the verify — no special
+    casing). hist [S, H] is the per-slot token history (prompt +
+    generated so far), hist_len [S] its live length. O(H * ngram)
+    compares per slot — noise next to the verify forward it feeds."""
+    S, H = hist.shape
+    pos = jnp.arange(H)
+    tail_idx = jnp.clip(hist_len[:, None] - ngram + jnp.arange(ngram)[None, :],
+                        0, H - 1)
+    tail = jnp.take_along_axis(hist, tail_idx, axis=1)          # [S, n]
+    win_idx = jnp.clip(pos[:, None] + jnp.arange(ngram)[None, :], 0, H - 1)
+    wins = hist[:, win_idx]                                     # [S, H, n]
+    ok = (wins == tail[:, None, :]).all(-1)                     # [S, H]
+    # window must END before the tail itself starts repeating it
+    # (host rule: i ranges over len-ngram-1 .. 0), and a history no
+    # longer than the ngram has nothing to look up
+    ok &= (pos[None, :] + ngram) <= (hist_len[:, None] - 1)
+    ok &= (hist_len > ngram)[:, None]
+    i_star = jnp.max(jnp.where(ok, pos[None, :], -1), axis=1)   # [S]
+    src = i_star[:, None] + ngram + jnp.arange(gamma)[None, :]  # [S, gamma]
+    valid = (i_star >= 0)[:, None] & (src < hist_len[:, None])
+    cont = jnp.take_along_axis(hist, jnp.clip(src, 0, H - 1), axis=1)
+    return jnp.where(valid, cont, 0).astype(jnp.int32)
+
+
+#: Draft-source registry for the serving spec block
+#: (RuntimeConfig.draft_model selects by name). A source is a pure jax
+#: callable (hist [S, H], hist_len [S], gamma, ngram) -> drafts
+#: [S, gamma] int32, traced INSIDE the jitted spec scan — a small
+#: on-device draft model registers a closure over its own params here
+#: (its whole gamma-step greedy decode then fuses into the verify
+#: program). "ngram" is the model-free prompt-lookup default.
+DRAFT_SOURCES: Dict[str, object] = {"ngram": _ngram_drafts}
+
+
+def register_draft_source(name: str, fn) -> None:
+    """Register a custom draft source (see DRAFT_SOURCES contract)."""
+    DRAFT_SOURCES[name] = fn
 
 
 class ServingEngine:
@@ -176,9 +218,18 @@ class ServingEngine:
         self._fwd = fwd
         self._use_kernels = use_kernels
         self._decode_blocks: Dict[int, object] = {}
-        # batched multi-token greedy verify (scheduler speculative mode)
-        self._verify = jax.jit(
-            partial(_verify_all, self.cfg, fwd), donate_argnums=(2,))
+        # Fused speculative blocks (scheduler speculative mode): one
+        # jitted program per round count, like _decode_blocks. The
+        # draft source resolves from runtime.draft_model NOW so a typo
+        # fails at engine build, not at the first spec dispatch.
+        self._spec_blocks: Dict[int, object] = {}
+        if self.runtime.speculative_gamma > 0:
+            name = self.runtime.draft_model
+            if name not in DRAFT_SOURCES:
+                raise ValueError(
+                    f"unknown draft source {name!r}: expected one of "
+                    f"{sorted(DRAFT_SOURCES)} (register_draft_source)")
+            self._draft_fn = DRAFT_SOURCES[name]
 
     def _mesh_ctx(self):
         import contextlib
@@ -410,32 +461,50 @@ class ServingEngine:
                 k_pages=kp, v_pages=vp,
                 k_scale_pages=ksp, v_scale_pages=vsp)
 
-    def verify_active(self, tokens: np.ndarray,
-                      active: np.ndarray) -> np.ndarray:
-        """Batched (gamma+1)-token greedy verify for every slot
-        (sched/scheduler.py speculative mode): one warm forward over
-        [S, C] draft chunks at each slot's current length, writing ALL
-        positions' K/V. Returns the per-position greedy next tokens
-        [S, C]. Rejected positions leave stale K/V that the next
-        verify/decode rewrites before any query can attend that far
-        (write-then-attend — engine.generate_speculative docs); the
-        scheduler rolls device lengths back to the accepted counts via
-        fix_lengths."""
+    def _spec_block_prog(self, rounds: int):
+        prog = self._spec_blocks.get(rounds)
+        if prog is None:
+            rt = self.runtime
+            prog = jax.jit(
+                partial(_spec_scan, self.cfg, self._fwd, rounds,
+                        rt.speculative_gamma, rt.speculative_ngram,
+                        self._draft_fn, use_kernel=self._use_kernels),
+                static_argnums=(8, 9), donate_argnums=(1, 3))
+            self._spec_blocks[rounds] = prog
+        return prog
+
+    def spec_block_async(self, hist, hist_len, active: np.ndarray,
+                         temps: np.ndarray, stops: np.ndarray,
+                         budgets, spec_mask: np.ndarray, key: jax.Array,
+                         rounds: int):
+        """Dispatch ONE fused speculative block — `rounds` chained
+        draft → batched-verify → on-device-accept rounds for every
+        active slot in a single jitted lax.scan (_spec_scan) — with no
+        host sync. The speculative twin of decode_block_async: drafts
+        come from the device-resident token history (`hist`/`hist_len`,
+        the carry the scheduler chains block t+1 on before block t is
+        drained), acceptance/rollback masks are computed inside the
+        scan (rejection-sampling correction at temperature > 0, the
+        `_accept_drafts` greedy semantics at 0), and per-slot stop ids
+        + remaining budgets kill finished slots on device exactly like
+        the decode block. `budgets` may be a host array (first dispatch
+        after a barrier) or the previous block's device-resident
+        remainder. Returns (toks [rounds, S, C], valid [rounds, S, C],
+        hist, hist_len, rem), all device-resident — the stacked
+        emissions + validity masks for the scheduler's stacked drain,
+        and the carry for chaining the next dispatch."""
         self._sync_table()
         with self._mesh_ctx():
-            greedy, cache = self._verify(self.params, jnp.asarray(tokens),
-                                         self.cache, jnp.asarray(active))
+            toks, valid, hist, hist_len, rem, cache = \
+                self._spec_block_prog(rounds)(
+                    self.params, hist, jnp.asarray(hist_len, jnp.int32),
+                    self.cache, jnp.asarray(active, bool),
+                    jnp.asarray(temps), jnp.asarray(stops, jnp.int32),
+                    jnp.asarray(budgets, jnp.int32),
+                    self.runtime_top_k, self.runtime_top_p, key,
+                    jnp.asarray(spec_mask, bool))
         self.cache = cache
-        return np.asarray(greedy)
-
-    def fix_lengths(self, mask: np.ndarray, values: np.ndarray) -> None:
-        """lengths[slot] = values[slot] where mask — the speculative
-        accept rollback (verify advanced every active slot by the full
-        draft length)."""
-        with self._mesh_ctx():
-            self.cache = self.cache._replace(
-                lengths=jnp.where(jnp.asarray(mask), jnp.asarray(values),
-                                  self.cache.lengths))
+        return toks, valid, hist, hist_len, rem
 
     # static sampling knobs (per-slot temps are dynamic)
     @property
@@ -531,12 +600,96 @@ def _decode_scan(cfg: ModelConfig, fwd, k: int, params, tokens,
     return block, final, cache
 
 
-def _verify_all(cfg: ModelConfig, fwd, params, tokens, cache: PagedKVCache,
-                active):
-    """[S, C] draft chunks -> per-position greedy next tokens [S, C].
+def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
+               draft_fn, params, hist, hist_len, cache: PagedKVCache,
+               active, temps, stops, budgets, top_k: int, top_p: float,
+               key, spec_mask, use_kernel: bool = False):
+    """`rounds` chained speculative rounds in ONE lax.scan — the
+    speculative twin of _decode_scan, emitting 1..gamma+1 tokens per
+    live slot per round instead of exactly one.
 
-    One warm multi-token paged forward (T = C = gamma+1): the dense
-    gather-attention path with the absolute-position causal mask — the
-    same program shape as a chunked warm prefill."""
-    logits, cache = fwd(params, cfg, tokens, cache, active=active)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+    Each round, for every live slot at once: (1) draft gamma tokens
+    from the device-resident history (`draft_fn` — prompt lookup by
+    default, or a registered draft model); (2) run ONE batched
+    (gamma+1)-token verify forward over [S, C] chunks (the dense warm
+    multi-token path — the same program shape as a chunked warm
+    prefill), writing ALL positions' K/V; (3) accept/correct ON DEVICE
+    (sampling.speculative_accept: rejection-sampling correction at
+    temperature > 0, `_accept_drafts` greedy semantics at 0);
+    (4) truncate the emitted run at the slot's stop id / remaining
+    budget, roll the slot's cache length back to its written-token
+    count, and append the survivors to the history carry. No host
+    round-trip decides acceptance — the host drains stacked
+    (tokens, validity) blocks after the fact, exactly like decode.
+
+    KV correctness under rejection is the write-then-attend argument
+    (engine.generate_speculative docs): rejected positions hold stale
+    K/V past the rolled-back length, and the next round's chunk —
+    which starts at that length and spans gamma+1 >= the stale run —
+    rewrites them before any query can attend that far. Writes past a
+    slot's allocated pages (the last verify's slack) land on the null
+    page via the block-table default, same as dead-slot decode writes.
+
+    Liveness is the decode block's contract: a slot starts dead if
+    inactive, out of budget, or its last history token is its stop id;
+    it goes dead the round a valid emission hits the stop id or spends
+    the budget (lengths freeze, later writes null out via `active`
+    masking), so a chained block dispatched before this one drains
+    starts it dead too.
+
+    Returns (toks [rounds, S, C], valid [rounds, S, C], hist,
+    hist_len, rem, cache) — valid[r, s, c] marks toks[r, s, c] as a
+    real emission of round r (in (round, position) order).
+    """
+    S, H = hist.shape
+    C = gamma + 1
+    has_stop = stops >= 0
+    col = jnp.arange(C)[None, :]
+    rows = jnp.arange(S)[:, None]
+    last0 = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    live0 = active & (budgets > 0) \
+        & jnp.where(has_stop, last0 != stops, True)
+
+    def body(carry, i):
+        hist, hlen, cache, live, rem = carry
+        drafts = draft_fn(hist, hlen, gamma, ngram)
+        last = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        toks = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, C]
+        W = cache.lengths
+        # use_kernel rides through for the decode-kernel plumbing, but
+        # a verify is a T=C>1 warm step: paged_layer_body routes it to
+        # the dense gather path regardless (kernels are T==1 / fresh)
+        logits, cache = fwd(params, cfg, toks, cache, active=live,
+                            use_kernel=use_kernel)
+        emitted, n_acc = speculative_accept(
+            logits, drafts, jax.random.fold_in(key, i), temps,
+            top_k, top_p, spec_mask)
+        # emitted prefix n_acc+1, clipped at the remaining budget, cut
+        # at the first stop id INCLUSIVE (the stop token itself emits,
+        # like _emit's host truncation)
+        cand = (col <= n_acc[:, None]) & (col < rem[:, None])
+        stop_at = cand & has_stop[:, None] & (emitted == stops[:, None])
+        prior = jnp.cumsum(stop_at.astype(jnp.int32), axis=1) \
+            - stop_at.astype(jnp.int32)
+        valid = cand & (prior == 0) & live[:, None]
+        m = valid.sum(axis=1).astype(jnp.int32)
+        # written tokens are the old chain token + the accepted drafts:
+        # roll the verify's +C advance back to W + m (the last emitted
+        # token — correction/bonus — is never written, decode-style)
+        cache = cache._replace(lengths=jnp.where(live, W + m, W))
+        wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
+        cur = jnp.take_along_axis(hist, wpos, axis=1)
+        hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
+        hlen = jnp.where(live, hlen + m, hlen)
+        rem = jnp.where(live, rem - m, rem)
+        died = (valid & has_stop[:, None]
+                & (emitted == stops[:, None])).any(axis=1)
+        live = live & ~died & (rem > 0)
+        return (hist, hlen, cache, live, rem), (emitted, valid)
+
+    (hist, hist_len, cache, _, rem), (toks_blk, valid_blk) = lax.scan(
+        body, (hist, hist_len, cache, live0, budgets),
+        jnp.arange(rounds, dtype=jnp.int32))
+    return toks_blk, valid_blk, hist, hist_len, rem, cache

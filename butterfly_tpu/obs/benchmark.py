@@ -278,6 +278,100 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     return out
 
 
+def run_spec_benchmark(model, params, *, n_requests: int = 8,
+                       prompt_len: int = 32, max_new: int = 64,
+                       max_batch: int = 4, gamma: int = 4, ngram: int = 2,
+                       decode_steps_per_tick: int = 4,
+                       inflight_blocks: int = 2,
+                       kv_quant: str = "none", seed: int = 0) -> Dict:
+    """Speculation phase of the serving bench: spec-on vs spec-off
+    tokens/sec at the SAME operating point, plus the speculation
+    instruments (spec_tokens_per_forward, spec_accept_rate) and the
+    no-per-round-barrier property (drain barriers per verify round).
+
+    The workload is deliberately draft-friendly: each prompt is seeded
+    with the model's OWN greedy continuation (measured once up front),
+    so prompt-lookup drafts actually land — random prompts would
+    measure the correction's overhead, not speculation (the accept
+    rate rides the JSON either way, so the number stays honest).
+    Batched saturated drain at `max_batch` slots, greedy (the
+    byte-parity regime the serving tests pin)."""
+    import jax
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    rng = np.random.RandomState(seed)
+    V = model.cfg.vocab_size
+    seed_len = max(4, prompt_len // 4)
+    max_seq = prompt_len + 2 * max_new + 16
+
+    def base_prompt():
+        return rng.randint(1, V, (seed_len,)).tolist()
+
+    def build(rt):
+        return Scheduler(ServingEngine(model, params, rt))
+
+    rt_off = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
+                           kv_quant=kv_quant,
+                           decode_steps_per_tick=decode_steps_per_tick,
+                           inflight_blocks=inflight_blocks)
+    rt_on = rt_off.replace(speculative_gamma=gamma,
+                           speculative_ngram=ngram)
+
+    # phase 0: harvest each base prompt's greedy continuation so the
+    # measured prompts carry the looping structure prompt lookup mines
+    probe = build(rt_off)
+    bases = [base_prompt() for _ in range(n_requests)]
+    cont = [probe.submit(b, max_new_tokens=prompt_len - seed_len)
+            for b in bases]
+    probe.run_until_done(max_ticks=10 ** 6)
+    prompts = [b + r.output for b, r in zip(bases, cont)]
+
+    results = {}
+    for label, rt in (("off", rt_off), ("on", rt_on)):
+        sched = build(rt)
+        # warm the programs (incl. the spec block) off the clock
+        for p in prompts[:min(len(prompts), max_batch)]:
+            sched.submit(p, max_new_tokens=4)
+        sched.run_until_done(max_ticks=10 ** 6)
+        reqs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.monotonic()
+        sched.run_until_done(max_ticks=10 ** 6)
+        wall = time.monotonic() - t0
+        unfinished = [r.id for r in reqs if r.state != "finished"]
+        if unfinished:
+            raise RuntimeError(
+                f"spec benchmark ({label}) left requests unfinished "
+                f"(ids {unfinished[:8]})")
+        results[label] = (sched.metrics(), wall)
+
+    m_on, wall_on = results["on"]
+    m_off, wall_off = results["off"]
+    out = {
+        "serving_spec_gamma": gamma,
+        "serving_spec_tokens_per_sec": m_on["tokens_generated_total"]
+        / wall_on,
+        "serving_spec_off_tokens_per_sec": m_off["tokens_generated_total"]
+        / wall_off,
+        "spec_tokens_per_forward": m_on.get("spec_tokens_per_forward", 0.0),
+        "spec_accept_rate": m_on.get("spec_accept_rate", 0.0),
+        "spec_forwards_total": m_on["spec_forwards_total"],
+        "spec_drafts_accepted_total": m_on["spec_drafts_accepted_total"],
+        # full barriers per verify round: ~0 in steady state is the
+        # pipeline property (the pre-block implementation barriered
+        # once per round by construction)
+        "spec_drain_barriers_per_forward":
+            m_on["drain_barriers_total"]
+            / max(1.0, m_on["spec_forwards_total"]),
+    }
+    out["serving_spec_speedup"] = (out["serving_spec_tokens_per_sec"]
+                                   / out["serving_spec_off_tokens_per_sec"]
+                                   if out["serving_spec_off_tokens_per_sec"]
+                                   else 0.0)
+    return out
+
+
 def _loadgen():
     """Import tools/loadgen.py (stdlib-only, lives outside the package
     — same sys.path dance the router tests use)."""

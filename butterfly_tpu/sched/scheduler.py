@@ -21,9 +21,13 @@ engine/serving.py:
   before block t is drained, so the tick's host section — admission,
   operand assembly, the stacked fetch itself — overlaps the device
   computing earlier blocks instead of idling it. A membership change
-  (admission work, a finish surfacing at drain, preemption, cancel,
-  speculative rounds) forces a FULL drain barrier so host and device
-  bookkeeping reconcile before the next dispatch. Long prompts are
+  (admission work, a finish surfacing at drain, preemption, cancel)
+  forces a FULL drain barrier so host and device bookkeeping reconcile
+  before the next dispatch. Speculative mode dispatches fused SPEC
+  blocks through the same pipeline: drafts come from a device-resident
+  token history, acceptance (with the rejection-sampling correction at
+  temperature > 0) is computed inside the scan, and blocks chain on
+  the (history, budgets) carry — no per-round barrier. Long prompts are
   split into prefill_chunk-sized pieces that continue the warm cache
   across ticks (partially-prefilled gang members carry over), so a
   max-length admission can never head-of-line-block decoding requests
@@ -59,6 +63,11 @@ from butterfly_tpu.engine.serving import (
 from butterfly_tpu.obs.registry import (
     BATCH_BUCKETS, LATENCY_BUCKETS, TOKEN_BUCKETS, MetricsRegistry)
 
+#: spec_accept_rate histogram buckets: acceptance fractions in [0, 1]
+#: (upper bounds; the 1.0 bucket is the all-drafts-accepted round)
+SPEC_ACCEPT_BUCKETS = (0.01, 0.125, 0.25, 0.375, 0.5,
+                       0.625, 0.75, 0.875, 1.0)
+
 
 def _device_ready(x) -> bool:
     """Non-blocking completion probe for a device array (jax.Array
@@ -91,6 +100,11 @@ class Request:
     # runners at the next drain barrier — an expired request never
     # occupies a decode slot past its budget.
     deadline_s: Optional[float] = None
+    # per-request speculation opt-out (only meaningful when the server
+    # runs with speculative_gamma > 0): False rides the spec block but
+    # ignores its drafts — the slot emits one exact plain-decode sample
+    # per verify round (speculative_accept spec_mask semantics)
+    speculative: bool = True
     # where the deadline fired ("waiting" | "running"), for the 504 body
     expired_where: Optional[str] = None
     # runtime state
@@ -170,12 +184,14 @@ class Scheduler:
         self._ids = itertools.count()
         self._key = jax.random.PRNGKey(seed)
         self._next_tokens = np.zeros((engine.num_slots,), np.int32)
-        # In-flight fused decode blocks: [(final device token vector
-        # [S], stacked block [k, S], k, slot->(request, generation)
-        # snapshot, dispatch timestamp), ...] in dispatch order. Each
-        # tick dispatches ONE jitted k-step scan
-        # (engine.decode_block_async) chained on the previous block's
-        # device-resident final tokens, and up to
+        # In-flight fused blocks, tagged tuples in dispatch order:
+        #   ("decode", final [S] carry, block [k, S], k, snapshot, t)
+        #   ("spec",   hist_len [S],   (toks [R, S, C], valid
+        #              [R, S, C]), R rounds, snapshot, t)
+        # where snapshot maps slot -> (request, generation). Each tick
+        # dispatches ONE jitted scan (engine.decode_block_async or
+        # engine.spec_block_async) chained on the previous block's
+        # device-resident carry, and up to
         # RuntimeConfig.inflight_blocks of them stay undrained
         # (dispatch-ahead): the host fetches only the OLDEST block when
         # the queue fills, so its drain + the next tick's scheduling
@@ -218,6 +234,23 @@ class Scheduler:
         # Admissions write their first token into it with a device-side
         # .at[].set, so dispatching never needs the host values.
         self._next_dev = None
+        # Speculative-mode device carries (allocated only with
+        # speculative_gamma > 0): the per-slot token history
+        # [S, cache.max_seq] + live lengths the on-device drafter reads
+        # (admissions write their prompt + first token in; spec blocks
+        # append their own emissions in-scan), and the remaining-budget
+        # vector the chained dispatches thread through
+        # (None = rebuild from host state at the next dispatch — set at
+        # every full drain barrier, when the host again knows every
+        # emitted token).
+        self._spec_mode = rt.speculative_gamma > 0
+        self._hist_dev = None
+        self._hist_len_dev = None
+        self._spec_rem = None
+        if self._spec_mode:
+            H = engine.cache.max_seq
+            self._hist_dev = jnp.zeros((engine.num_slots, H), jnp.int32)
+            self._hist_len_dev = jnp.zeros((engine.num_slots,), jnp.int32)
         # Typed instruments (obs/registry.py) replace the old ad-hoc
         # Dict[str, float]: counters for the monotonic totals, fixed-
         # bucket histograms for the latency/size distributions /metrics
@@ -237,10 +270,31 @@ class Scheduler:
             "preemptions_total",
             "Recompute preemptions under page pressure")
         self._c_spec_fwd = reg.counter(
-            "spec_forwards_total", "Speculative verify forwards")
+            "spec_forwards_total",
+            "Speculative verify forwards that did work (spec-block "
+            "rounds with at least one live slot)")
         self._c_spec_acc = reg.counter(
             "spec_drafts_accepted_total",
             "Draft tokens accepted by speculative verify")
+        self._c_spec_tok = reg.counter(
+            "spec_block_tokens_total",
+            "Tokens emitted from speculative verify blocks (accepted "
+            "drafts + corrections/bonus samples); divided by "
+            "spec_forwards_total this is tokens/forward — the number "
+            "speculation exists to push past 1")
+        self._h_accept = reg.histogram(
+            "spec_accept_rate",
+            "Per-slot-round draft acceptance fraction (accepted / "
+            "gamma) over emitted rounds of speculating requests — 0 "
+            "means every round paid a full verify for one token",
+            SPEC_ACCEPT_BUCKETS)
+        self._c_barriers = reg.counter(
+            "drain_barriers_total",
+            "FULL drain barriers (every in-flight block fetched, "
+            "pipeline restarts cold). Compare with spec_forwards_total "
+            "/ tick count: a healthy pipeline drains lazily and "
+            "barriers only on membership changes, never once per "
+            "decode or spec round")
         self._h_ttft = reg.histogram(
             "ttft_seconds",
             "Time to first token (submit -> first token drained)",
@@ -353,7 +407,8 @@ class Scheduler:
                on_token=None, on_finish=None,
                request_id: Optional[str] = None,
                priority: str = "interactive",
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               speculative: bool = True) -> Request:
         # Reject what can never fit: a request that exceeds the per-seq
         # page limit or the whole pool would self-preempt forever.
         worst = -(-(len(prompt) + max_new_tokens) // self.alloc.page_size)
@@ -362,11 +417,6 @@ class Scheduler:
                 f"request needs {worst} KV pages (prompt {len(prompt)} + "
                 f"max_new {max_new_tokens}) but the limit is "
                 f"{min(self.alloc.max_pages_per_seq, self.alloc.num_pages)}")
-        if self.engine.runtime.speculative_gamma > 0 and temperature > 0:
-            raise ValueError(
-                "speculative serving is greedy-only (stochastic drafts "
-                "would need the rejection-sampling correction): submit "
-                "with temperature=0 or disable speculative_gamma")
         if priority not in ("interactive", "batch"):
             raise ValueError(f"unknown priority {priority!r}: expected "
                              "'interactive' or 'batch'")
@@ -374,6 +424,7 @@ class Scheduler:
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       stop_token=stop_token, client_id=request_id,
                       priority=priority, deadline_s=deadline_s,
+                      speculative=bool(speculative),
                       on_token=on_token, on_finish=on_finish)
         self.waiting.append(req)
         self._c_requests.inc()
@@ -492,6 +543,7 @@ class Scheduler:
         self._inflight = []
         self._pending_first = []
         self._pending_first_keys.clear()
+        self._spec_rem = None
         self._epoch += 1  # cached decode operands are now stale
         for req in self.unfinished_requests():
             req.state = "cancelled"
@@ -545,14 +597,21 @@ class Scheduler:
           the shrunken batch must be visible before the next dispatch;
         * page pressure (_ensure_or_preempt) — preemption must never
           reclaim pages a dispatched block still writes;
-        * cancel() — same hazard, external trigger;
-        * speculative mode — every round is host-synchronous by nature.
+        * cancel() — same hazard, external trigger.
+
+        Speculative mode (speculative_gamma > 0) runs the SAME pipeline
+        with _spec_block in place of _decode_block: drafts come from
+        the device-resident token history, acceptance is computed
+        inside the scan, and the chained carry is (history, lengths,
+        remaining budgets) instead of the final-token vector — no
+        barrier per round (the pre-block-machinery implementation
+        drained every round to draft on the host).
 
         Returns the number of tokens generated this round (throughput
         accounting for the serve loop)."""
         before = self._c_tokens.value
         rt = self.engine.runtime
-        spec = rt.speculative_gamma > 0
+        spec = self._spec_mode
         k = max(1, rt.decode_steps_per_tick)
         depth = max(1, rt.inflight_blocks)
         # deadline scrub first: an expired request must not survive
@@ -561,11 +620,11 @@ class Scheduler:
         self._t_host0 = time.monotonic()
         self._had_inflight_at_host0 = bool(self._inflight)
         self._idle_at_host0 = self._had_inflight_at_host0 and \
-            _device_ready(self._inflight[-1][0])
+            _device_ready(self._inflight[-1][1])
         # lazy drain: consume the oldest block once the queue is full
         # (depth=1 degenerates to the old drain-every-tick loop). A
         # finish surfacing there is a membership change -> full barrier.
-        while not spec and len(self._inflight) >= depth:
+        while len(self._inflight) >= depth:
             if self._drain_oldest():
                 self._drain_inflight()
         # admission barrier — only when admission can actually make
@@ -577,35 +636,31 @@ class Scheduler:
         self._admit()
         if self.running:
             self._h_batch.observe(len(self.running))
-        if spec:
-            # speculative rounds stay synchronous single dispatches
-            # (each round's drafts need the previous round's tokens on
-            # the host), so the fused block doesn't apply
-            for _ in range(k):
-                if self.running:
-                    self._spec_step()
-        else:
-            # Preallocate pages for every step still in flight PLUS
-            # this block up front: device lengths run ahead of the host
-            # mirror by up to k per undrained block, so the horizon is
-            # (inflight+1)*k + 1 (chain token + the new samples) — and
-            # the block table dirties (syncs to the device) at most
-            # once per TICK (docs/decode_profile_r5.md capacity
-            # section). Any more would add spurious page pressure in a
-            # tight pool; under pressure _ensure_or_preempt falls back
-            # to a drain barrier before it ever preempts.
-            horizon = (len(self._inflight) + 1) * k + 1
-            for req in list(self.running):
-                if req in self.running:
-                    need = min(len(req.all_tokens) + horizon,
-                               len(req.prompt) + req.max_new_tokens)
-                    self._ensure_or_preempt(req, need)
-            if not self._decode_block(k) and \
-                    (self._inflight or self._pending_first):
-                # nothing dispatchable (every budget is spent on
-                # device): the remaining tokens exist only in flight —
-                # fetch them now or the loop would spin forever
-                self._drain_inflight()
+        # Preallocate pages for every step still in flight PLUS this
+        # block up front: device lengths run ahead of the host mirror
+        # by up to `step` tokens per undrained block (k samples for a
+        # decode block, k rounds x (gamma+1) emissions for a spec
+        # block), so the horizon is (inflight+1)*step + 1 (chain token
+        # + the new samples) — and the block table dirties (syncs to
+        # the device) at most once per TICK
+        # (docs/decode_profile_r5.md capacity section). Any more would
+        # add spurious page pressure in a tight pool; under pressure
+        # _ensure_or_preempt falls back to a drain barrier before it
+        # ever preempts. A spec verify's trailing writes past the
+        # lifetime clamp land on the null page via the table default.
+        step = k * (rt.speculative_gamma + 1) if spec else k
+        horizon = (len(self._inflight) + 1) * step + 1
+        for req in list(self.running):
+            if req in self.running:
+                need = min(len(req.all_tokens) + horizon,
+                           len(req.prompt) + req.max_new_tokens)
+                self._ensure_or_preempt(req, need)
+        dispatched = self._spec_block(k) if spec else self._decode_block(k)
+        if not dispatched and (self._inflight or self._pending_first):
+            # nothing dispatchable (every budget is spent on device):
+            # the remaining tokens exist only in flight — fetch them
+            # now or the loop would spin forever
+            self._drain_inflight()
         self._g_inflight.set(len(self._inflight))
         made = int(self._c_tokens.value - before)
         if self.trace is not None:
@@ -614,7 +669,7 @@ class Scheduler:
             self.trace.event(None, "decode_tick",
                              batch=len(self.running),
                              waiting=len(self.waiting),
-                             steps=k, block_steps=0 if spec else k,
+                             steps=k, block_steps=k, spec=spec,
                              inflight=len(self._inflight),
                              generated=made)
         return made
@@ -636,7 +691,19 @@ class Scheduler:
             "preemptions_total": self._c_preempt.value,
             "spec_forwards_total": self._c_spec_fwd.value,
             "spec_drafts_accepted_total": self._c_spec_acc.value,
+            "drain_barriers_total": self._c_barriers.value,
         }
+        if self._spec_mode:
+            fwd = self._c_spec_fwd.value
+            m["spec_block_tokens_total"] = self._c_spec_tok.value
+            # the speculation headline: tokens each verify forward paid
+            # for (1.0 = speculation is earning nothing over plain
+            # decode; > 1 = drafts are landing)
+            m["spec_tokens_per_forward"] = \
+                self._c_spec_tok.value / fwd if fwd else 0.0
+            h = self._h_accept
+            m["spec_accept_rate"] = \
+                h._sum / h._count if h._count else 0.0
         m["queue_depth"] = len(self.waiting)
         m["active_requests"] = len(self._all_live)
         m["kv_pages_free"] = self.alloc.free_pages
@@ -868,6 +935,22 @@ class Scheduler:
             else jnp.asarray(self._next_tokens)
         slots_arr = np.asarray([r.slot for r in reqs], np.int32)
         self._next_dev = base.at[slots_arr].set(firsts)
+        if self._spec_mode:
+            # seed the device-side token history the on-device drafter
+            # reads: the full prompt (+ prior output on readmission)
+            # from the host, plus the device-resident first token —
+            # no host sync, the spec block chains on this carry
+            H = self._hist_dev.shape[1]
+            rows = np.zeros((len(reqs), H), np.int32)
+            lens = np.zeros((len(reqs),), np.int32)
+            for i, req in enumerate(reqs):
+                toks = req.all_tokens
+                rows[i, :len(toks)] = toks
+                lens[i] = len(toks)
+            self._hist_dev = self._hist_dev.at[slots_arr].set(
+                jnp.asarray(rows)).at[slots_arr, lens].set(firsts)
+            self._hist_len_dev = self._hist_len_dev.at[slots_arr].set(
+                jnp.asarray(lens + 1))
         for i, req in enumerate(reqs):
             self._pending_first.append(
                 (req, req.preemptions, req.slot, firsts[i]))
@@ -901,36 +984,13 @@ class Scheduler:
         """
         if not self.running:
             return False
-        S = self.engine.num_slots
-        if self._operands_epoch != self._epoch:
-            active = np.zeros((S,), bool)
-            temps = np.zeros((S,), np.float32)
-            stops = np.full((S,), -1, np.int32)
-            base = np.zeros((S,), np.int32)
-            for req in self.running:
-                active[req.slot] = True
-                temps[req.slot] = req.temperature
-                stops[req.slot] = req.stop_token
-                # tokens the request may still emit: max_new minus what
-                # the host has drained, minus an undrained
-                # admission-time first token (queued in _pending_first;
-                # set lookup — the old per-runner linear scan over the
-                # pending list was O(running x pending) every block)
-                pending = (req.id,
-                           req.preemptions) in self._pending_first_keys
-                base[req.slot] = (req.max_new_tokens - len(req.output)
-                                  - int(pending))
-            self._operands = (active, temps, stops, base,
-                              {req.slot: (req, req.preemptions)
-                               for req in self.running})
-            self._operands_epoch = self._epoch
-        active, temps, stops, base, snapshot = self._operands
+        active, temps, stops, base, specm, snapshot = self._assemble()
         # steps dispatched but undrained: the device consumed (at most)
         # this much of each live slot's budget already. A slot that
         # went dead early consumed less, but its chain token is frozen
         # at its stop id (or its budget is genuinely spent), so
         # under-budgeting it cannot drop real tokens.
-        ahead = sum(e[2] for e in self._inflight)
+        ahead = sum(e[3] for e in self._inflight)
         budgets = np.maximum(base - ahead, 0) if ahead else base
         if not (active & (budgets > 0)).any():
             return False  # every runner is out of budget on device
@@ -943,7 +1003,44 @@ class Scheduler:
         block, final = self.engine.decode_block_async(
             cur, active, temps, stops, budgets, sub, k)
         self._next_dev = final
-        self._inflight.append((final, block, k, snapshot, time.monotonic()))
+        self._inflight.append(("decode", final, block, k, snapshot,
+                               time.monotonic()))
+        self._note_bubble()
+        return True
+
+    def _assemble(self) -> tuple:
+        """Per-block host operands — the active/temps/stops/base-budget
+        /spec-mask arrays and the slot snapshot — cached on the batch-
+        membership epoch: back-to-back blocks over an unchanged batch
+        skip the per-slot Python rebuild and the np.asarray churn."""
+        if self._operands_epoch != self._epoch:
+            S = self.engine.num_slots
+            active = np.zeros((S,), bool)
+            temps = np.zeros((S,), np.float32)
+            stops = np.full((S,), -1, np.int32)
+            base = np.zeros((S,), np.int32)
+            specm = np.zeros((S,), bool)
+            for req in self.running:
+                active[req.slot] = True
+                temps[req.slot] = req.temperature
+                stops[req.slot] = req.stop_token
+                specm[req.slot] = req.speculative
+                # tokens the request may still emit: max_new minus what
+                # the host has drained, minus an undrained
+                # admission-time first token (queued in _pending_first;
+                # set lookup — the old per-runner linear scan over the
+                # pending list was O(running x pending) every block)
+                pending = (req.id,
+                           req.preemptions) in self._pending_first_keys
+                base[req.slot] = (req.max_new_tokens - len(req.output)
+                                  - int(pending))
+            self._operands = (active, temps, stops, base, specm,
+                              {req.slot: (req, req.preemptions)
+                               for req in self.running})
+            self._operands_epoch = self._epoch
+        return self._operands
+
+    def _note_bubble(self) -> None:
         if self._idle_at_host0:
             # the newest in-flight carry was already materialized when
             # this tick's host section began: the device sat idle
@@ -955,70 +1052,60 @@ class Scheduler:
             self._h_bubble.observe(0.0)
             self._bubbles.append(0.0)
         self._idle_at_host0 = self._had_inflight_at_host0 = False
-        return True
 
-    def _spec_step(self) -> None:
-        """One speculative round: per-slot prompt-lookup drafts, ONE
-        batched (gamma+1)-token verify forward, host accept loop.
+    def _spec_block(self, rounds: int) -> bool:
+        """Dispatch ONE fused speculative block (engine.spec_block_async)
+        — `rounds` chained draft → batched-multi-slot-verify →
+        on-device-accept rounds — chained on the device-resident
+        history/budget carry exactly like _decode_block chains on the
+        final-token vector, so `inflight_blocks >= 2` pipelines spec
+        rounds with host scheduling (no full drain barrier per round:
+        the old host accept loop drained EVERY round).
 
-        Token-for-token identical to plain greedy decode (the engine
-        generate_speculative contract, batched across slots): drafts
-        only change how many forwards the tokens take. The verify
-        advances every active slot's device length by the full draft
-        width; fix_lengths rolls each back to its accepted count.
-        Synchronous (no in-flight chain): the next round's drafts need
-        this round's tokens on the host.
-        """
-        from butterfly_tpu.engine.engine import _accept_drafts, _ngram_draft
-        rt = self.engine.runtime
-        gamma, ngram = rt.speculative_gamma, rt.speculative_ngram
-        C = gamma + 1
-        self._drain_inflight()  # drafts need every host-visible token
-        for req in list(self.running):
-            if req in self.running:
-                need = min(len(req.all_tokens) + C,
-                           len(req.prompt) + req.max_new_tokens)
-                self._ensure_or_preempt(req, need)
+        Budgets: the first dispatch after a full barrier seeds the
+        device budget vector from exact host state (base, minus
+        nothing — the barrier drained every in-flight token); chained
+        dispatches thread the previous block's device-resident
+        remainder through, because a spec block's consumption is
+        variable (1..gamma+1 tokens per live slot per round) and only
+        the device knows it before the drain. Membership changes force
+        a barrier anyway, so the carry is always exact.
+
+        Returns True iff a block was dispatched."""
         if not self.running:
-            return
-
-        S = self.engine.num_slots
-        toks = np.zeros((S, C), np.int32)
-        active = np.zeros((S,), bool)
-        drafts: Dict[int, List[int]] = {}
-        for req in self.running:
-            d = _ngram_draft(req.all_tokens, gamma, ngram)
-            toks[req.slot, 0] = req.all_tokens[-1]
-            toks[req.slot, 1:] = d
-            drafts[req.slot] = d
-            active[req.slot] = True
-        greedy = self.engine.verify_active(toks, active)
-        self._c_spec_fwd.inc()
-
-        mask = np.zeros((S,), bool)
-        vals = np.zeros((S,), np.int32)
-        for req in list(self.running):
-            slot = req.slot
-            emitted = _accept_drafts(drafts[slot], greedy[slot])
-            n_before = len(req.output)
-            for t in emitted:
-                self._emit(req, t)
-                if req.done:
-                    break
-            # count only drafts actually EMITTED (stop/max_new may
-            # truncate mid-group); the first token isn't a draft
-            self._c_spec_acc.inc(max(0, len(req.output) - n_before - 1))
-            if req.slot is not None:  # still running: roll length back
-                mask[slot] = True
-                vals[slot] = len(req.all_tokens) - 1
-                self._next_tokens[slot] = req.output[-1]
-        self.engine.fix_lengths(mask, vals)
+            return False
+        active, temps, stops, base, specm, snapshot = self._assemble()
+        if self._spec_rem is None:
+            if not (active & (base > 0)).any():
+                return False  # everything already emitted (undrained)
+            budgets = base
+        else:
+            # device carry: exact remainder after every in-flight
+            # round. The host cannot cheaply inspect it; dispatching a
+            # potentially-empty block is safe — each tick still drains
+            # the oldest block, so finishes keep surfacing and the
+            # barrier-on-finish resets the carry to host truth.
+            budgets = self._spec_rem
+        self._key, sub = jax.random.split(self._key)
+        toks, valid, hist, hlen, rem = self.engine.spec_block_async(
+            self._hist_dev, self._hist_len_dev, active, temps, stops,
+            budgets, specm, sub, rounds)
+        self._hist_dev, self._hist_len_dev, self._spec_rem = hist, hlen, rem
+        self._inflight.append(("spec", hlen, (toks, valid), rounds,
+                               snapshot, time.monotonic()))
+        self._note_bubble()
+        return True
 
     def _drain_inflight(self) -> bool:
         """FULL drain barrier: fetch every pending first token and
-        in-flight decode block in ONE stacked device read. Returns True
-        if any request finished."""
+        in-flight block in ONE stacked device read. Returns True if any
+        request finished. In spec mode the device budget carry resets
+        to None — the host again knows every emitted token, so the
+        next dispatch reseeds it from exact host state."""
+        if self._inflight or self._pending_first:
+            self._c_barriers.inc()
         blocks, self._inflight = self._inflight, []
+        self._spec_rem = None
         return self._drain_blocks(blocks)
 
     def _drain_oldest(self) -> bool:
@@ -1052,8 +1139,16 @@ class Scheduler:
         if not blocks and not firsts:
             return False
         finished_before = self._c_finished.value
-        parts = [f[3].reshape(1) for f in firsts] + \
-            [block.reshape(-1) for _, block, _, _, _ in blocks]
+        C = self.engine.runtime.speculative_gamma + 1
+        parts = [f[3].reshape(1) for f in firsts]
+        for ent in blocks:
+            if ent[0] == "decode":
+                parts.append(ent[2].reshape(-1))
+            else:  # spec: stacked emissions + validity mask ride the
+                # same single fetch (bool widened to the int dtype)
+                toks3, valid3 = ent[2]
+                parts.append(toks3.reshape(-1))
+                parts.append(valid3.astype(jnp.int32).reshape(-1))
         vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
             else np.asarray(parts[0])
         now = time.monotonic()
@@ -1067,8 +1162,16 @@ class Scheduler:
             self._next_tokens[slot] = int(tok)
             self._emit(req, int(tok))
         off = nf
-        for _, block, k, snapshot, t_dispatch in blocks:
+        for ent in blocks:
+            kind, _, _, k, snapshot, t_dispatch = ent
             self._h_decode_block.observe(now - t_dispatch)
+            if kind == "spec":
+                toks3 = vals[off:off + k * S * C].reshape(k, S, C)
+                off += k * S * C
+                valid3 = vals[off:off + k * S * C].reshape(k, S, C) != 0
+                off += k * S * C
+                self._emit_spec(toks3, valid3, snapshot)
+                continue
             rows = vals[off:off + k * S].reshape(k, S)
             off += k * S
             for slot, (req, gen) in snapshot.items():
@@ -1085,6 +1188,43 @@ class Scheduler:
                         break
         self._epoch += 1  # outputs / pending-first changed
         return self._c_finished.value > finished_before
+
+    def _emit_spec(self, toks3: np.ndarray, valid3: np.ndarray,
+                   snapshot: Dict) -> None:
+        """Emit one drained spec block: toks3/valid3 [R, S, C] hold
+        each round's emissions per slot (valid marks the real ones —
+        device-truncated at stop/budget). Host emission walks rounds in
+        dispatch order per live slot, re-truncating via _emit's done
+        check as a backstop; per-round acceptance feeds the spec
+        instruments (a round's emissions are 1 correction/bonus plus
+        `count-1` accepted drafts)."""
+        R = toks3.shape[0]
+        gamma = self.engine.runtime.speculative_gamma
+        # verify forwards that did work: rounds with ANY valid emission
+        # (trailing all-dead rounds in a block ran but verified nothing)
+        self._c_spec_fwd.inc(int(np.any(valid3, axis=(1, 2)).sum()))
+        for slot, (req, gen) in snapshot.items():
+            if req.done or req.slot != slot or req.preemptions != gen:
+                continue
+            t_rows = toks3[:, slot, :].tolist()
+            v_rows = valid3[:, slot, :].tolist()
+            for r in range(R):
+                cnt = 0
+                for tok, ok in zip(t_rows[r], v_rows[r]):
+                    if not ok:
+                        continue
+                    cnt += 1
+                    self._next_tokens[slot] = tok
+                    self._emit(req, tok)
+                    if req.done:
+                        break
+                if cnt:
+                    self._c_spec_tok.inc(cnt)
+                    self._c_spec_acc.inc(max(0, cnt - 1))
+                    if req.speculative and gamma > 0:
+                        self._h_accept.observe((cnt - 1) / gamma)
+                if req.done:
+                    break
 
     def _emit(self, req: Request, token: int) -> None:
         """Record one generated token; finish/stop bookkeeping."""

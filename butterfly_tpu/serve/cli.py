@@ -75,8 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--seed", type=int, default=0)
     g.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
                    help="prompt-lookup speculative decoding: draft GAMMA "
-                        "tokens per step, verify in one forward (greedy "
-                        "only; output identical to plain decode)")
+                        "tokens per step, verify in one forward. Greedy "
+                        "output is identical to plain decode; with "
+                        "--temperature > 0 the rejection-sampling "
+                        "correction keeps the output distribution exact")
 
     s = sub.add_parser("serve", help="HTTP serving with continuous batching")
     common(s)
@@ -107,10 +109,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefix (content-hashed, refcounted; cuts TTFT for "
                         "shared system prompts)")
     s.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
-                   help="serving-path prompt-lookup speculative decoding: "
-                        "draft GAMMA tokens per slot, verify all slots in "
-                        "one batched forward (greedy-only: requests with "
-                        "temperature > 0 are rejected)")
+                   help="serving-path speculative decoding on the block "
+                        "pipeline: draft GAMMA tokens per slot from the "
+                        "device-side token history, verify ALL slots in "
+                        "one batched (GAMMA+1)-token forward per round, "
+                        "accept/rollback on device. Sampling-safe "
+                        "(rejection-sampling correction keeps "
+                        "temperature/top-k/top-p requests exact); "
+                        "clients opt out per request with "
+                        '"speculative": false')
+    s.add_argument("--draft-source", default="ngram",
+                   help="spec-block draft source (RuntimeConfig."
+                        "draft_model): 'ngram' = prompt lookup over the "
+                        "device-side history; custom sources register "
+                        "via engine.serving.register_draft_source")
     def positive_int(v):
         n = int(v)
         if n < 1:
@@ -118,15 +130,13 @@ def build_parser() -> argparse.ArgumentParser:
         return n
 
     s.add_argument("--decode-steps-per-tick", type=positive_int, default=1,
-                   help="fused decode-block width: this many decode "
-                        "iterations run per scheduler tick inside ONE "
+                   help="fused block width: this many decode iterations "
+                        "(or, with --speculate, draft+verify+accept "
+                        "rounds) run per scheduler tick inside ONE "
                         "jitted scan (on-device sampling, RNG, and EOS "
                         "masking), drained in ONE stacked fetch. Raise "
                         "to amortize per-token host overhead (tokens "
-                        "then surface in bursts of this size). NB: with "
-                        "--speculate the verify rounds are "
-                        "host-synchronous, so the block applies to "
-                        "plain decoding only")
+                        "then surface in bursts)")
     s.add_argument("--prefill-max-batch", type=positive_int, default=8,
                    help="max waiting requests gang-admitted into ONE "
                         "batched [B, Tbucket] prefill dispatch per "
@@ -383,12 +393,9 @@ def cmd_generate(args) -> int:
               f"{args.seq_parallel}-way sequence parallelism", file=sys.stderr)
         return 0
     if args.speculate > 0:
-        if args.temperature > 0:
-            print("error: --speculate requires greedy decoding "
-                  "(--temperature 0)", file=sys.stderr)
-            return 2
         try:
-            res = engine.generate_speculative(ids, sp, gamma=args.speculate)
+            res = engine.generate_speculative(ids, sp, gamma=args.speculate,
+                                              seed=args.seed)
         except NotImplementedError as e:  # e.g. data/stage-parallel mesh
             print(f"error: {e}", file=sys.stderr)
             return 2

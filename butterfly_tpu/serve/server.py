@@ -6,7 +6,9 @@ zero-egress environment):
 
 * POST /generate  {"prompt": str | "tokens": [int], "max_tokens"
                    (alias "max_new_tokens"), "temperature", "stop_token",
-                   "stream": bool}
+                   "stream": bool, "speculative": bool (default true —
+                   set false to opt one request out of draft acceptance
+                   on a --speculate server; composes with temperature)}
   -> {"text", "tokens", "ttft_s", "total_s"}; with "stream": true the
   response is SSE (`data: {"token": id, "text": piece}` per token,
   terminated by `data: [DONE]`).
@@ -265,7 +267,8 @@ class ServerState:
     # -- handler-thread API ---------------------------------------------------
 
     def submit(self, tokens, max_tokens, temperature, stop_token,
-               request_id=None, priority="interactive", deadline_s=None):
+               request_id=None, priority="interactive", deadline_s=None,
+               speculative=True):
         """Admit one request. Returns (req, queue); (None, retry_after
         float) when SLO-aware admission SHED it (predicted TTFT busts
         the declared objective — the handler answers 429 with the
@@ -296,7 +299,8 @@ class ServerState:
                                     on_token=on_token, on_finish=on_finish,
                                     request_id=request_id,
                                     priority=priority,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s,
+                                    speculative=speculative)
         self.wake.set()
         return req, q
 
@@ -529,7 +533,7 @@ def make_handler(state: ServerState):
 
         def _parse_request(self, body: dict):
             """Shared validation -> (tokens, max_tokens, temperature,
-            stop, rid, priority, deadline_ms).
+            stop, rid, priority, deadline_ms, speculative).
 
             Accepts our native schema and the OpenAI-completions field
             names (`prompt` may be a string OR a token-id list there;
@@ -537,7 +541,12 @@ def make_handler(state: ServerState):
             `deadline_ms` (body) / `X-Deadline-Ms` (header, wins) is
             the REMAINING latency budget at arrival — routers and the
             fleet control plane decrement it per hop; `priority` /
-            `X-Priority` selects the admission class."""
+            `X-Priority` selects the admission class. `speculative`
+            (default true) composes with the sampling params: false
+            opts this request's slot out of draft acceptance on a
+            --speculate server (it still rides the batched verify,
+            emitting one exact plain-decode sample per round); ignored
+            when the server runs without --speculate."""
             if "tokens" in body:
                 tokens = [int(t) for t in body["tokens"]]
             else:
@@ -579,8 +588,11 @@ def make_handler(state: ServerState):
             deadline_ms = float(dl) if dl is not None else None
             if deadline_ms is not None and not deadline_ms == deadline_ms:
                 raise ValueError("deadline_ms must be a number")  # NaN
+            speculative = body.get("speculative", True)
+            if not isinstance(speculative, bool):
+                raise ValueError("speculative must be a boolean")
             return (tokens, max_tokens, temperature, stop, rid,
-                    priority, deadline_ms)
+                    priority, deadline_ms, speculative)
 
         def _deadline_504(self, where: str, deadline_ms,
                           elapsed_s: float, openai: bool,
@@ -617,7 +629,7 @@ def make_handler(state: ServerState):
 
             try:
                 (tokens, max_tokens, temperature, stop, rid, priority,
-                 deadline_ms) = self._parse_request(body)
+                 deadline_ms, speculative) = self._parse_request(body)
             except (ValueError, TypeError, KeyError) as e:
                 err(400, str(e), "invalid_request_error")
                 return None
@@ -638,7 +650,8 @@ def make_handler(state: ServerState):
             try:
                 req, q = state.submit(tokens, max_tokens, temperature, stop,
                                       request_id=rid, priority=priority,
-                                      deadline_s=deadline_s)
+                                      deadline_s=deadline_s,
+                                      speculative=speculative)
             except ValueError as e:  # can never fit the page pool
                 err(400, str(e), "invalid_request_error")
                 return None
@@ -1016,6 +1029,7 @@ def run_server(args) -> int:
                        prefix_caching=getattr(args, "prefix_caching", False),
                        kv_quant=getattr(args, "kv_quant", "none"),
                        speculative_gamma=getattr(args, "speculate", 0),
+                       draft_model=getattr(args, "draft_source", "ngram"),
                        decode_steps_per_tick=getattr(
                            args, "decode_steps_per_tick", 1),
                        prefill_max_batch=getattr(

@@ -88,6 +88,12 @@ SLOW_TESTS = {
     "test_request_sized_to_page_cap_completes",
     "test_speculative_scheduler_accepts_drafts",
     "test_speculative_scheduler_stop_token",
+    # spec-block scenarios that compile several schedulers (the fast
+    # tier still covers the block path: greedy parity, sampling
+    # support, and the no-per-round-barrier pipelining property)
+    "test_speculative_parity_grid",
+    "test_speculative_per_request_opt_out",
+    "test_speculative_parity_under_preemption_pressure",
     # fused-block scenarios that compile a second scheduler / a wide
     # scan (the fast tier still covers the fused path: every core
     # parity test decodes through it, incl. test_decode_steps_per_tick)

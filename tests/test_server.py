@@ -66,6 +66,19 @@ def test_generate_blocking(server):
     assert out["ttft_s"] >= 0 and out["total_s"] > 0
 
 
+def test_speculative_body_knob(server):
+    """Per-request speculation opt-out: accepted (and inert) on a
+    non-speculating server, rejected when not a boolean."""
+    out = post(server, "/generate",
+               {"tokens": [5, 7, 11], "max_tokens": 4, "stop_token": -1,
+                "speculative": False})
+    assert len(out["tokens"]) == 4
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/generate",
+             {"tokens": [5, 7], "max_tokens": 2, "speculative": "yes"})
+    assert e.value.code == 400
+
+
 def test_generate_token_ids_deterministic(server):
     a = post(server, "/generate",
              {"tokens": [5, 7, 11], "max_tokens": 5, "stop_token": -1})
@@ -335,18 +348,29 @@ def test_openai_completions_malformed_n_is_400(server):
 
 
 def test_openai_completions_stop_token_excluded_from_text(server):
-    # discover the greedy continuation, then stop on its 3rd token
+    # Discover the greedy continuation, then stop on its first token
+    # value that did NOT already occur earlier in the continuation:
+    # picking a fixed index broke when the tiny model's greedy chain
+    # settled into a repeat (the "3rd token" then also matched token 1
+    # and generation legitimately stopped there with empty text).
     ref = post(server, "/generate",
                {"tokens": [5, 7, 11], "max_tokens": 6, "stop_token": -1})
-    stop = ref["tokens"][2]
+    idx = next((i for i, t in enumerate(ref["tokens"])
+                if i > 0 and t not in ref["tokens"][:i]), None)
+    if idx is None:
+        import pytest
+        pytest.skip("greedy continuation is a single repeated token: "
+                    "no stop position can leave preceding text")
+    stop = ref["tokens"][idx]
     out = post(server, "/v1/completions",
                {"prompt": [5, 7, 11], "max_tokens": 6, "stop_token": stop})
     (choice,) = out["choices"]
     assert choice["finish_reason"] == "stop"
     # stop marker excluded from text; usage still counts it
     from butterfly_tpu.utils.tokenizer import ByteTokenizer
-    assert choice["text"] == ByteTokenizer().decode(ref["tokens"][:2])
-    assert out["usage"]["completion_tokens"] == 3
+    want_text = ByteTokenizer().decode(ref["tokens"][:idx])
+    assert choice["text"] == want_text
+    assert out["usage"]["completion_tokens"] == idx + 1
 
     # streaming path: the stop token's chunk is skipped too
     resp = post(server, "/v1/completions",
@@ -357,7 +381,7 @@ def test_openai_completions_stop_token_excluded_from_text(server):
     chunks = [json.loads(e) for e in events[:-1]]
     assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
     texts = [c["choices"][0]["text"] for c in chunks[:-1]]
-    assert "".join(texts) == ByteTokenizer().decode(ref["tokens"][:2])
+    assert "".join(texts) == want_text
 
 
 # -- stop sequences ---------------------------------------------------------

@@ -1,0 +1,135 @@
+"""Statistical distribution parity for speculative sampling.
+
+The rejection-sampling correction (engine.sampling.speculative_accept,
+Leviathan et al. 2023) must make speculative output EXACTLY
+target-distributed at temperature > 0 — for the one-hot prompt-lookup
+proposal: accept draft d_i with probability p_i(d_i), resample the
+first rejection from the residual p_i with d_i masked out, bonus-sample
+position gamma when everything lands. These tests pin that law
+empirically on small vocabularies (chi-square-style max-deviation
+bounds at N large enough that a biased kernel fails deterministically),
+plus the greedy-row fast path and the per-request opt-out semantics.
+
+Kernel-level deliberately: the serving spec block and
+engine.generate_speculative both emit through this one kernel, and
+end-to-end empirical distribution tests over a whole model would need
+thousands of scheduler runs for the same statistical power.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.engine.sampling import (
+    _filter_logits, speculative_accept)
+
+V, GAMMA = 8, 3
+
+
+def _target(logits_row, temp, top_k=0, top_p=1.0):
+    """The distribution plain decode samples from at this position."""
+    scaled = _filter_logits(jnp.asarray(logits_row) / temp, top_k, top_p)
+    return np.asarray(jax.nn.softmax(scaled))
+
+
+def _draw(logits, drafts, temps, n, top_k=0, top_p=1.0, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    f = jax.jit(jax.vmap(lambda k: speculative_accept(
+        jnp.asarray(logits), jnp.asarray(drafts, jnp.int32), k,
+        jnp.asarray(temps, jnp.float32), top_k, top_p)))
+    em, na = f(keys)
+    return np.asarray(em), np.asarray(na)
+
+
+def test_first_token_marginal_matches_target():
+    """P(emitted[0] = x) must equal p_0(x) regardless of the draft:
+    accepted-draft mass + residual-resample mass reassemble exactly."""
+    rng = np.random.RandomState(0)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    for draft0 in (int(np.argmax(logits[0, 0])),          # likely draft
+                   int(np.argmin(logits[0, 0]))):         # unlikely draft
+        drafts = np.asarray([[draft0, 1, 5]])
+        em, _ = _draw(logits, drafts, [0.7], 20000)
+        emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
+        tgt = _target(logits[0, 0], 0.7)
+        assert np.abs(emp - tgt).max() < 0.015, (draft0, emp, tgt)
+
+
+def test_second_token_conditional_matches_target():
+    """Given the first draft accepted, emitted[1] must be distributed
+    as p_1 — the joint law equals autoregressive sampling."""
+    rng = np.random.RandomState(1)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    d0 = int(np.argmax(logits[0, 0]))  # high-probability first draft
+    drafts = np.asarray([[d0, 2, 6]])
+    em, na = _draw(logits, drafts, [0.8], 30000)
+    sel = na[:, 0] >= 1            # first draft accepted
+    assert sel.sum() > 5000        # enough mass to test on
+    emp = np.bincount(em[sel, 0, 1], minlength=V) / sel.sum()
+    tgt = _target(logits[0, 1], 0.8)
+    assert np.abs(emp - tgt).max() < 0.02
+
+
+def test_acceptance_probability_is_p_of_draft():
+    """P(n_acc >= 1) must equal p_0(d_1) — the min(1, p/q) rule with a
+    one-hot q."""
+    rng = np.random.RandomState(2)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    d0 = 3
+    drafts = np.asarray([[d0, 0, 0]])
+    _, na = _draw(logits, drafts, [1.0], 20000)
+    p_d = _target(logits[0, 0], 1.0)[d0]
+    assert abs((na[:, 0] >= 1).mean() - p_d) < 0.015
+
+
+def test_filters_respected():
+    """top-k filtering applies to acceptance AND resampling: a draft
+    outside the top-k nucleus is always rejected, and no emitted token
+    ever falls outside the nucleus."""
+    rng = np.random.RandomState(3)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    k = 3
+    outside = int(np.argsort(logits[0, 0])[0])  # worst token: not in top-3
+    drafts = np.asarray([[outside, 0, 0]])
+    em, na = _draw(logits, drafts, [0.9], 4000, top_k=k)
+    assert (na[:, 0] == 0).all()  # zero filtered mass -> never accepted
+    nucleus = set(np.argsort(logits[0, 0])[-k:].tolist())
+    assert set(em[:, 0, 0].tolist()) <= nucleus
+
+
+def test_greedy_rows_match_accept_drafts():
+    """temp-0 rows reproduce the host _accept_drafts semantics (the
+    serving byte-parity contract)."""
+    from butterfly_tpu.engine.engine import _accept_drafts
+    rng = np.random.RandomState(4)
+    for trial in range(20):
+        logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+        drafts = rng.randint(0, V, (1, GAMMA))
+        em, na = speculative_accept(
+            jnp.asarray(logits), jnp.asarray(drafts, jnp.int32),
+            jax.random.PRNGKey(trial), jnp.asarray([0.0], jnp.float32),
+            0, 1.0)
+        n = int(np.asarray(na)[0]) + 1
+        got = np.asarray(em)[0, :n].tolist()
+        greedy = np.argmax(logits[0], axis=-1)
+        assert got == _accept_drafts(drafts[0].tolist(), greedy), trial
+
+
+def test_opt_out_rows_sample_full_distribution():
+    """spec_mask=False rows must emit ONE token from the FULL target
+    distribution — no draft acceptance, and critically no residual
+    exclusion bias against the draft token."""
+    rng = np.random.RandomState(5)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    d0 = int(np.argmax(logits[0, 0]))  # the draft IS the mode: any
+    drafts = np.asarray([[d0, 0, 0]])  # exclusion bias would be glaring
+    keys = jax.random.split(jax.random.PRNGKey(6), 20000)
+    f = jax.jit(jax.vmap(lambda k: speculative_accept(
+        jnp.asarray(logits), jnp.asarray(drafts, jnp.int32), k,
+        jnp.asarray([0.7], jnp.float32), 0, 1.0,
+        jnp.asarray([False]))))
+    em, na = f(keys)
+    em, na = np.asarray(em), np.asarray(na)
+    assert (na == 0).all()
+    emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
+    tgt = _target(logits[0, 0], 0.7)
+    assert np.abs(emp - tgt).max() < 0.015

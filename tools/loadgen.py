@@ -70,7 +70,8 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
              slo_ttft_ms: Optional[float] = None,
              slo_itl_ms: Optional[float] = None,
              deadline_ms: Optional[float] = None,
-             priority: Optional[str] = None) -> Dict:
+             priority: Optional[str] = None,
+             speculative: Optional[bool] = None) -> Dict:
     """Drive `url` closed-loop; returns aggregate stats.
 
     Every request uses token-id prompts (deterministic, tokenizer-free).
@@ -120,6 +121,8 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
                 payload["deadline_ms"] = deadline_ms
             if priority is not None:
                 payload["priority"] = priority
+            if speculative is not None:
+                payload["speculative"] = speculative
             body = json.dumps(payload).encode()
             req = urllib.request.Request(
                 url + path, data=body,
@@ -269,7 +272,8 @@ def run_fleet_soak(url: str, clients: int = 4,
                    slo_ttft_ms: Optional[float] = None,
                    slo_itl_ms: Optional[float] = None,
                    deadline_ms: Optional[float] = None,
-                   priority: Optional[str] = None) -> Dict:
+                   priority: Optional[str] = None,
+                   speculative: Optional[bool] = None) -> Dict:
     """Fleet soak: closed-loop load against a control plane WHILE every
     replica is rolled through drain -> (restart) -> undrain, one at a
     time. The pass/fail property is the router tier's: zero dropped
@@ -296,7 +300,7 @@ def run_fleet_soak(url: str, clients: int = 4,
             tail_len=tail_len, max_tokens=max_tokens, seed=seed,
             vocab=vocab, timeout=timeout, slo_ttft_ms=slo_ttft_ms,
             slo_itl_ms=slo_itl_ms, deadline_ms=deadline_ms,
-            priority=priority))
+            priority=priority, speculative=speculative))
 
     t = threading.Thread(target=_load)
     t.start()
@@ -353,6 +357,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     default=None,
                     help="admission class tag: 'batch' is shed first "
                          "when SLO-aware admission is active")
+    ap.add_argument("--speculative", choices=["on", "off"], default=None,
+                    help="stamp \"speculative\": true/false on every "
+                         "request (per-request opt-in/out of draft "
+                         "acceptance on a `serve --speculate` replica; "
+                         "omit to leave the server default)")
     ap.add_argument("--soak", action="store_true",
                     help="fleet soak mode: roll every replica through "
                          "drain/undrain (discovered via "
@@ -372,7 +381,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                slo_ttft_ms=args.slo_ttft_ms,
                                slo_itl_ms=args.slo_itl_ms,
                                deadline_ms=args.deadline_ms,
-                               priority=args.priority)
+                               priority=args.priority,
+                               speculative=(None if args.speculative is None
+                                            else args.speculative == "on"))
     else:
         stats = run_load(args.url, clients=args.clients,
                          requests_per_client=args.requests,
@@ -382,7 +393,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          path=args.path, slo_ttft_ms=args.slo_ttft_ms,
                          slo_itl_ms=args.slo_itl_ms,
                          deadline_ms=args.deadline_ms,
-                         priority=args.priority)
+                         priority=args.priority,
+                         speculative=(None if args.speculative is None
+                                      else args.speculative == "on"))
     if args.json:
         print(json.dumps(stats, indent=2))
     else:

@@ -85,12 +85,19 @@ MUTANTS = [
      "out = self.text[self.released:cut]",
      "out = self.text[self.released:cut + 1]",
      ["tests/test_server.py"], {}),
-    # speculative decoding: accept mismatched drafts (the ONE shared
-    # accept loop — engine generate_speculative AND scheduler _spec_step)
+    # speculative decoding: accept mismatched drafts in the engine's
+    # host accept loop (generate_speculative greedy fast path)
     ("butterfly_tpu/engine/engine.py",
      "if d != int(greedy[i]):",
      "if False and d != int(greedy[i]):",
-     ["tests/test_speculative.py", "tests/test_sched.py"], {}),
+     ["tests/test_speculative.py"], {}),
+    # speculative serving: accept mismatched drafts in the DEVICE
+    # accept kernel's greedy rows (the serving spec block's byte-parity
+    # contract — test_sched greedy parity + the kernel unit tests)
+    ("butterfly_tpu/engine/sampling.py",
+     "drafts == greedy_tok[:, :gamma]",
+     "jnp.ones_like(drafts, dtype=bool)",
+     ["tests/test_sched.py", "tests/test_spec_sampling.py"], {}),
     # allocator: hand out one page fewer than needed. Must pin the
     # PYTHON backend: with the native lib built, the scheduler uses the
     # C++ twin and a Python-side mutation is invisible (first mutcheck

@@ -21,10 +21,15 @@ ISSUE 5): block 2 is dispatched on block 1's device-resident carry
 before block 1 is drained, so the trace shows whether the device runs
 the blocks back-to-back (no bubble) while the host sits in between.
 
+`--spec` traces one batched SPECULATIVE block (ISSUE 9): k rounds of
+draft + [S, gamma+1] multi-slot verify + on-device accept as one
+jitted scan (engine._spec_scan) — the speculative twin of --serving.
+
 Usage: python tools/profile_decode.py [--max-new N] [--out DIR]
        python tools/profile_decode.py --serving [--steps-per-tick K]
        python tools/profile_decode.py --prefill [--prefill-max-batch B]
        python tools/profile_decode.py --pipeline [--steps-per-tick K]
+       python tools/profile_decode.py --spec [--gamma G]
 """
 from __future__ import annotations
 
@@ -69,6 +74,15 @@ def main() -> int:
                          "on block 1's device carry before block 1 is "
                          "drained) — shows whether the device runs "
                          "them back-to-back with no bubble")
+    ap.add_argument("--spec", action="store_true",
+                    help="trace ONE batched speculative verify block "
+                         "(engine._spec_scan: draft + multi-slot "
+                         "verify + on-device accept rounds as one "
+                         "jitted scan) — the speculative twin of "
+                         "--serving")
+    ap.add_argument("--gamma", type=int, default=4,
+                    help="draft width for --spec (matches "
+                         "RuntimeConfig.speculative_gamma)")
     args = ap.parse_args()
 
     import jax
@@ -107,6 +121,8 @@ def main() -> int:
         return _profile_prefill_batch(args, model, params, kv_quant)
     if args.pipeline:
         return _profile_pipeline(args, model, params, kv_quant)
+    if args.spec:
+        return _profile_spec_block(args, model, params, kv_quant)
     if args.serving:
         return _profile_serving_block(args, model, params, kv_quant)
     engine = InferenceEngine(
@@ -200,6 +216,73 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
     jax.profiler.start_trace(logdir)
     sched._decode_block(k)
     jax.block_until_ready(sched._inflight[-1][1])
+    jax.profiler.stop_trace()
+    sched.run_until_done(max_ticks=10 ** 6)
+    return _report(logdir, args.top)
+
+
+def _profile_spec_block(args, model, params, kv_quant: str) -> int:
+    """Trace ONE batched speculative block (ISSUE 9): a speculating
+    Scheduler is warmed through real admissions until every slot
+    decodes — prompts seeded with each request's own greedy
+    continuation so prompt-lookup drafts land — then a single
+    `--steps-per-tick`-round spec block is dispatched inside the trace
+    window: the draft gathers, the [S, gamma+1] verify forwards, and
+    the on-device accept/rollback one tick() pays for."""
+    import jax
+    import numpy as np
+
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    k = args.steps_per_tick
+    gamma = args.gamma
+    cfg = model.cfg
+    # budget: warmup rounds PLUS the traced block's worst case
+    # (k rounds x gamma+1 emissions per slot)
+    max_new = max(args.max_new, 3 * k * (gamma + 1) + 8)
+    rt = RuntimeConfig(max_batch_size=args.batch,
+                       max_seq_len=args.prompt_len + max_new + gamma + 16,
+                       kv_quant=kv_quant, decode_steps_per_tick=k,
+                       speculative_gamma=gamma,
+                       prefill_chunk=max(512, args.prompt_len * args.batch))
+    rng = np.random.RandomState(0)
+    # harvest greedy continuations with a plain scheduler so the traced
+    # workload is draft-friendly (looping structure for prompt lookup)
+    probe = Scheduler(ServingEngine(model, params,
+                                    rt.replace(speculative_gamma=0)))
+    half = max(1, args.prompt_len // 2)
+    bases = [rng.randint(1, cfg.vocab_size, (half,)).tolist()
+             for _ in range(args.batch)]
+    cont = [probe.submit(b, max_new_tokens=args.prompt_len - half)
+            for b in bases]
+    probe.run_until_done(max_ticks=10 ** 6)
+    prompts = [b + r.output for b, r in zip(bases, cont)]
+
+    engine = ServingEngine(model, params, rt)
+    sched = Scheduler(engine)
+    for p in prompts:
+        sched.submit(p, max_new_tokens=max_new)
+    # warm until every submission is admitted and speculating (compiles
+    # the prefill buckets + the spec block program off the clock)
+    while sched.waiting or sched._prefill_group:
+        sched.tick()
+    sched.tick()
+    sched._drain_inflight()
+    # replicate tick()'s page preallocation so the traced block pays no
+    # host-side growth, then capture exactly one fused spec dispatch
+    step = k * (gamma + 1)
+    for req in list(sched.running):
+        if req in sched.running:
+            need = min(len(req.all_tokens) + step + 1,
+                       len(req.prompt) + req.max_new_tokens)
+            sched._ensure_or_preempt(req, need)
+    jax.block_until_ready(engine.cache.lengths)
+    logdir = args.out or tempfile.mkdtemp(prefix="spec_block_trace_")
+    jax.profiler.start_trace(logdir)
+    sched._spec_block(k)
+    jax.block_until_ready(sched._inflight[-1][2][0])
     jax.profiler.stop_trace()
     sched.run_until_done(max_ticks=10 ** 6)
     return _report(logdir, args.top)
