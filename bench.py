@@ -5,9 +5,19 @@ Headline: steady-state decode throughput (tokens/sec/chip) for the
 BASELINE.json configs[1] model of record — Llama-3-8B geometry — in int8
 (weights + KV cache) on the available chip(s). Rounds 1-4 benchmarked a
 1.2B proxy; r5 moved to the 8B config of record, so `vs_baseline` is the
-ratio to the first 8B run (bench_baseline.json key "tpu_8b" — like the
-r1 baseline before it, it tracks our own improvement: the reference is
-an unimplemented scaffold with no published numbers, BASELINE.md).
+ratio to the first 8B run (bench_baseline.json key "tpu_8b" — the
+reference is an unimplemented scaffold with no published numbers,
+BASELINE.md).
+
+NB (VERDICT r5 flaw 2): `vs_baseline` carries NO cross-round signal
+across the r5 headline-model switch — r1-r4 ratios were against the
+1.2B proxy, r5+ against the 8B run, so the series is discontinuous and
+~1.0 by construction right after a re-baseline. The trend metrics of
+record are the physical ones: `hbm_util` / `mfu` (roofline fractions,
+model-switch-invariant) and the mixed-workload serving fields
+(`mixed_serving_tokens_per_sec`, `mixed_ttft_*`, `mixed_itl_req_mean_*`,
+`mixed_serving_preemptions`, the operating-point table) — see
+docs/observability.md §benchmark-json.
 
 The same line also carries the PRODUCT serving-path numbers (VERDICT r4
 item 1): Scheduler + ServingEngine + paged Pallas kernel + int8 KV pools
@@ -28,6 +38,7 @@ def main() -> int:
     from butterfly_tpu.obs.benchmark import (run_chaos_benchmark,
                                              run_decode_benchmark,
                                              run_fleet_benchmark,
+                                             run_mixed_benchmark,
                                              run_serving_benchmark,
                                              run_spec_benchmark)
     from butterfly_tpu.quant.int8 import init_params_quantized
@@ -120,6 +131,42 @@ def main() -> int:
                    gamma=4)
     serving.update(run_spec_benchmark(
         model, params, kv_quant=kv_quant, **spec_kw))
+    # Mixed-workload phase (ISSUE 10): the canned mixed_chat population
+    # (heterogeneous prompts 32-1024 on TPU, shared-prefix cohorts,
+    # priority/deadline mix) fired OPEN-LOOP in bursts against a page
+    # pool sized below worst-case demand, so preemption, SLO-aware
+    # shedding, deadline scrubbing, and the prefix cache are all
+    # measured instead of idle (the uniform phase above reports
+    # serving_preemptions: 0 by construction). Also emits the
+    # decode_steps_per_tick x inflight_blocks operating-point table +
+    # knee — the curve the round's operating point is chosen from.
+    if on_tpu:
+        # pool at 15% of worst-case demand: the cohort mix averages
+        # ~18 pages/request, so 32 contested slots (~576 pages) overrun
+        # the ~390-page pool while the largest single request (81
+        # pages) still fits — preemption measured, not configured away
+        mixed_kw = dict(n_requests=64, max_batch=32,
+                        prompt_lo=32, prompt_hi=1024,
+                        max_new_lo=16, max_new_hi=256, page_size=16,
+                        pool_fraction=0.15,
+                        decode_steps_per_tick=16, inflight_blocks=2,
+                        prefill_max_batch=16, kv_quant="int8",
+                        grid=[(4, 1), (4, 2), (16, 1), (16, 2)])
+    else:
+        # CPU smoke: decode budgets 16-48 keep slots alive across
+        # many blocks (short budgets drain before pressure builds) and
+        # the near-instant burst outruns the tiny model's service rate,
+        # so the 0.35-provisioned pool is genuinely contested (verified:
+        # every grid point preempts at this shape)
+        mixed_kw = dict(n_requests=12, max_batch=4,
+                        prompt_lo=8, prompt_hi=48,
+                        max_new_lo=16, max_new_hi=48, page_size=8,
+                        pool_fraction=0.35,
+                        arrival="burst:2000:0.5:0.1",
+                        decode_steps_per_tick=4, inflight_blocks=2,
+                        prefill_max_batch=4, kv_quant="none",
+                        grid=[(1, 1), (1, 2), (4, 1), (4, 2)])
+    serving.update(run_mixed_benchmark(model, params, **mixed_kw))
     toks_per_sec_chip = stats["tokens_per_sec_per_chip"]
 
     vs = 1.0

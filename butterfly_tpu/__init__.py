@@ -18,6 +18,8 @@ Layer map (see SURVEY.md §1.2 / §7):
   serve/     HTTP server + `butterfly serve|generate` CLI
   obs/       metrics, profiling hooks
   ckpt/      HF safetensors import, sharded save/load
+  workload/  stochastic traffic modeling: cohort populations, open-loop
+             arrivals, trace replay, operating-point sweeps
 """
 
 __version__ = "0.1.0"

@@ -265,16 +265,15 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     # itl_req_mean_* are the PRIMARY ITL keys: per-finished-request mean
     # gap, the streaming rate a client experiences. The raw-gap
     # percentiles bimodalize under per-tick stacked-drain bursts (r05
-    # headline reported itl_p50 == 0.0 between burst-mates), so they
-    # ride along under an explicit _tick_burst suffix for trajectory
+    # headline reported itl_p50 == 0.0 between burst-mates), so the
+    # scheduler now only exposes them under the _tick_burst suffix
+    # (ISSUE 10 satellite) and they ride along here for trajectory
     # continuity only.
     for k in ("ttft_p50", "ttft_p95",
-              "itl_req_mean_p50", "itl_req_mean_p95"):
+              "itl_req_mean_p50", "itl_req_mean_p95",
+              "itl_p50_tick_burst", "itl_p95_tick_burst"):
         if k in m:
             out[k] = m[k]
-    for k in ("itl_p50", "itl_p95"):
-        if k in m:
-            out[k + "_tick_burst"] = m[k]
     return out
 
 
@@ -369,6 +368,139 @@ def run_spec_benchmark(model, params, *, n_requests: int = 8,
                                    / out["serving_spec_off_tokens_per_sec"]
                                    if out["serving_spec_off_tokens_per_sec"]
                                    else 0.0)
+    return out
+
+
+def run_mixed_benchmark(model, params, *, n_requests: int = 32,
+                        max_batch: int = 8,
+                        prompt_lo: int = 32, prompt_hi: int = 256,
+                        max_new_lo: int = 8, max_new_hi: int = 64,
+                        page_size: int = 16,
+                        pool_fraction: float = 0.4,
+                        decode_steps_per_tick: int = 4,
+                        inflight_blocks: int = 2,
+                        grid=None, kv_quant: str = "none",
+                        prefill_max_batch: Optional[int] = None,
+                        slo_ttft_ms: Optional[float] = 1000.0,
+                        deadline_ms: Optional[float] = 30000.0,
+                        arrival: Optional[str] = None,
+                        seed: int = 0,
+                        max_seconds: float = 900.0) -> Dict:
+    """Mixed-workload serving phase (ISSUE 10): the canned
+    `mixed_chat` population (heterogeneous prompt/decode lengths,
+    shared-prefix cohorts, priority/deadline mix) fired OPEN-LOOP in
+    bursts sized to overrun a deliberately under-provisioned page pool
+    — the regime where chunked prefill, bucketing, preemption, the
+    prefix cache, and the PR-8 admission machinery actually run. The
+    uniform-traffic serving phase measures the best case; this one
+    measures the product.
+
+    Two sub-phases on ONE engine:
+
+    1. **Mixed phase** at the round's operating point
+       (`decode_steps_per_tick` x `inflight_blocks`): open-loop burst
+       arrivals through the PR-8 admission surface (shed_decision +
+       deadline budgets), with the pool at `pool_fraction` of
+       worst-case demand so bursts force `serving_preemptions > 0`.
+       Emits mixed_* throughput/TTFT/ITL keys plus the
+       preemption/shed/deadline counters.
+    2. **Operating-point sweep**: the SAME trace across a
+       `decode_steps_per_tick x inflight_blocks` grid (>= 2x2),
+       emitting the latency/throughput table + knee
+       (workload/sweep.py) — the curve ROADMAP items 1/3/5 are judged
+       against.
+    """
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+    from butterfly_tpu.workload.arrivals import (assign_arrivals,
+                                                 parse_arrival)
+    from butterfly_tpu.workload.models import mixed_chat
+    from butterfly_tpu.workload.sweep import (drive_open_loop,
+                                              sweep_operating_points)
+
+    wl = mixed_chat(page_size=page_size, vocab=model.cfg.vocab_size,
+                    prompt_lo=prompt_lo, prompt_hi=prompt_hi,
+                    max_new_lo=max_new_lo, max_new_hi=max_new_hi,
+                    deadline_ms=deadline_ms)
+    max_seq = wl.max_prompt_len + wl.max_new_hi + 16
+    pages_per_seq = -(-max_seq // page_size)
+    # pool sized BELOW worst-case concurrent demand: bursts must be
+    # able to overrun it (preemption is the property under
+    # measurement), while any single request still fits (admission
+    # validation needs worst-case pages + a little slack)
+    num_pages = max(pages_per_seq + 2,
+                    int(pool_fraction * max_batch * pages_per_seq))
+    if arrival is None:
+        # bursts at an offered rate far above any service rate
+        # (n_requests*2/s for ~0.25s ON phases): instantaneous queue
+        # growth + page-pool overrun on every platform — open loop is
+        # exactly the regime a closed-loop client count can't reach
+        arrival = f"burst:{max(8, 2 * n_requests)}:0.25:0.75"
+    specs = wl.sample(n_requests, seed)
+    assign_arrivals(specs, parse_arrival(arrival), seed)
+    base_rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
+                            page_size=page_size, num_pages=num_pages,
+                            kv_quant=kv_quant,
+                            decode_steps_per_tick=decode_steps_per_tick,
+                            inflight_blocks=inflight_blocks,
+                            prefix_caching=True)
+    if prefill_max_batch is not None:
+        base_rt = base_rt.replace(prefill_max_batch=prefill_max_batch)
+    engine = ServingEngine(model, params, base_rt)
+
+    # warm the round's operating point off the clock (the sweep warms
+    # its own grid points per distinct block width)
+    warm = Scheduler(engine)
+    for s in specs:
+        if len(s.tokens) + 1 <= engine.cache.max_seq:
+            warm.submit(s.tokens, max_new_tokens=2)
+    warm.run_until_done(max_ticks=10 ** 6)
+
+    slo_ttft_s = slo_ttft_ms / 1e3 if slo_ttft_ms else None
+    sched = Scheduler(engine, slo_ttft_s=slo_ttft_s)
+    res = drive_open_loop(sched, specs, max_seconds=max_seconds)
+
+    sweep_grid = grid
+    if sweep_grid is None:
+        ks = sorted({1, decode_steps_per_tick})
+        if len(ks) == 1:
+            ks = [decode_steps_per_tick, 2 * decode_steps_per_tick]
+        sweep_grid = [(k, i) for k in ks[:2] for i in (1, 2)]
+    sw = sweep_operating_points(engine, base_rt, specs, sweep_grid,
+                                slo_ttft_s=slo_ttft_s,
+                                max_seconds=max_seconds)
+
+    def r(v):
+        return round(v, 4) if isinstance(v, float) else v
+
+    out = {
+        "mixed_workload": wl.name,
+        "mixed_arrival": arrival,
+        "mixed_requests": n_requests,
+        "mixed_max_batch": max_batch,
+        "mixed_kv_quant": kv_quant,
+        "mixed_num_pages": num_pages,
+        "mixed_pool_fraction": r(pool_fraction),
+        "mixed_prompt_range": [prompt_lo, prompt_hi],
+        "mixed_max_new_range": [max_new_lo, max_new_hi],
+        "mixed_slo_ttft_ms": slo_ttft_ms,
+        "mixed_ok": res["ok"],
+        "mixed_admitted": res["admitted"],
+        "mixed_serving_tokens_per_sec": r(res["tokens_per_sec"]),
+        # the acceptance counter: > 0 means the page pool was actually
+        # contested (uniform rounds report serving_preemptions: 0)
+        "mixed_serving_preemptions": res["preemptions"],
+        "mixed_shed_total": res["shed_total"],
+        "mixed_deadline_expired_total": res["deadline_expired_total"],
+    }
+    for k in ("ttft_p50", "ttft_p95", "itl_req_mean_p50",
+              "itl_req_mean_p95", "prefix_cache_hit_tokens"):
+        if k in res:
+            out["mixed_" + k] = r(res[k])
+    out["operating_points"] = sw["points"]
+    out["operating_point_knee"] = (
+        {k: r(v) for k, v in sw["knee"].items()} if sw["knee"] else None)
     return out
 
 

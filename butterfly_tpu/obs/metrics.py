@@ -22,13 +22,14 @@ from typing import Dict, Optional
 
 PREFIX = "butterfly"
 
-# NB (ADVICE.md round 5): with pipelined decode dispatch, tokens surface
-# in per-tick stacked-drain BURSTS, so the raw-gap itl_p50/itl_p95 keys
-# bimodalize (p50 ~ 0, p95 ~ tick) and ttft_* includes up to one extra
-# tick of drain delay. Those keys keep their names for dashboard
-# continuity but their HELP text below marks the per-tick-burst
-# semantics; consumers who want the latency a streaming client actually
-# experiences should read itl_req_mean_* (per-request mean gap) or the
+# NB (ADVICE.md round 5 / ISSUE 10): with pipelined decode dispatch,
+# tokens surface in per-tick stacked-drain BURSTS, so the raw-gap ITL
+# percentiles bimodalize (p50 identically 0.0 between burst-mates at
+# decode_steps_per_tick > 1) and ttft_* includes up to one extra tick
+# of drain delay. The degenerate bare itl_p50/itl_p95 keys were DROPPED
+# (r05 published itl_p50: 0.0 as a headline number); the raw-gap values
+# survive only under the explicit *_tick_burst suffix. The ITL metrics
+# of record are itl_req_mean_* (per-request mean gap) and the
 # butterfly_ttft_seconds / butterfly_itl_req_mean_seconds histograms.
 HELP = {
     "requests_total": "Requests submitted",
@@ -44,14 +45,15 @@ HELP = {
                 "delay — see ttft_seconds histogram)",
     "ttft_p95": "p95 time-to-first-token (seconds; stamped at the "
                 "stacked drain — see ttft_seconds histogram)",
-    "itl_p50": "p50 inter-token latency (seconds; PER-TICK-BURST gap "
-               "semantics under pipelined dispatch — prefer "
-               "itl_req_mean_p50)",
-    "itl_p95": "p95 inter-token latency (seconds; PER-TICK-BURST gap "
-               "semantics under pipelined dispatch — prefer "
-               "itl_req_mean_p95)",
-    "itl_max": "max inter-token latency in the recent window (seconds; "
-               "per-tick-burst semantics)",
+    "itl_p50_tick_burst": "p50 raw inter-token gap (seconds; PER-TICK-"
+                          "BURST semantics under pipelined dispatch — "
+                          "identically 0.0 between burst-mates; prefer "
+                          "itl_req_mean_p50)",
+    "itl_p95_tick_burst": "p95 raw inter-token gap (seconds; PER-TICK-"
+                          "BURST semantics under pipelined dispatch — "
+                          "prefer itl_req_mean_p95)",
+    "itl_max_tick_burst": "max raw inter-token gap in the recent window "
+                          "(seconds; per-tick-burst semantics)",
     "itl_req_mean_p50": "p50 over finished requests of each request's "
                         "MEAN inter-token gap (seconds) — the "
                         "effective streaming rate a client experiences",

@@ -677,12 +677,14 @@ class Scheduler:
     def metrics(self) -> Dict[str, float]:
         """Legacy flat-dict view, assembled from the typed registry.
 
-        NB: the itl_p50/itl_p95/itl_max (and, to one tick, ttft_*) keys
-        carry PER-TICK-BURST semantics under pipelined dispatch — gaps
-        are stamped at the stacked drain, so the raw percentiles
-        bimodalize (p50 ~ 0, p95 ~ tick). Consumers should prefer
-        itl_req_mean_* or the registry's real histograms
-        (ttft_seconds, itl_req_mean_seconds); see obs/metrics.py HELP.
+        NB: the raw-gap ITL percentiles carry PER-TICK-BURST semantics
+        under pipelined dispatch — gaps are stamped at the stacked
+        drain, so they bimodalize (p50 ~ 0, p95 ~ tick) — and are
+        therefore exposed ONLY under itl_p50/p95/max_tick_burst
+        (ISSUE 10 satellite: the degenerate bare itl_p50/itl_p95 keys
+        are gone). The ITL metrics of record are itl_req_mean_* and
+        the registry's real histograms (ttft_seconds,
+        itl_req_mean_seconds); see obs/metrics.py HELP.
         """
         m: Dict[str, float] = {
             "requests_total": self._c_requests.value,
@@ -716,10 +718,16 @@ class Scheduler:
             m["ttft_p50"] = float(np.percentile(a, 50))
             m["ttft_p95"] = float(np.percentile(a, 95))
         if self._itls:
+            # raw-gap percentiles carry per-tick-burst semantics under
+            # pipelined dispatch (p50 is identically 0.0 between
+            # burst-mates at decode_steps_per_tick > 1 — the r05
+            # headline artifact), so they are ONLY exposed under the
+            # explicit _tick_burst suffix; itl_req_mean_* is the ITL
+            # metric of record
             a = np.asarray(self._itls)
-            m["itl_p50"] = float(np.percentile(a, 50))
-            m["itl_p95"] = float(np.percentile(a, 95))
-            m["itl_max"] = float(a.max())
+            m["itl_p50_tick_burst"] = float(np.percentile(a, 50))
+            m["itl_p95_tick_burst"] = float(np.percentile(a, 95))
+            m["itl_max_tick_burst"] = float(a.max())
         if self._itl_means:
             a = np.asarray(self._itl_means)
             m["itl_req_mean_p50"] = float(np.percentile(a, 50))

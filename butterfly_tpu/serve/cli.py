@@ -4,8 +4,9 @@
 
     butterfly generate --model gpt2-124m --prompt "hello" --max-new 32
     butterfly serve    --model llama3-8b --port 8000
-    butterfly bench    --model tiny
+    butterfly bench    --model tiny [--serving --mixed]
     butterfly route    --backends 10.0.0.1:8000,10.0.0.2:8000
+    butterfly workload generate|replay|sweep   (workload subsystem)
 
 Models load from --ckpt (HF safetensors dir or our sharded checkpoint);
 without --ckpt, weights are random-initialized (smoke/demo mode).
@@ -188,6 +189,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "`serve --inflight-blocks`); the serving JSON "
                         "carries device_bubble_p50/p95 so the overlap "
                         "is measurable at this depth")
+    b.add_argument("--mixed", action="store_true",
+                   help="also run the mixed-workload serving phase "
+                        "(ISSUE 10): the canned mixed_chat population "
+                        "fired open-loop in bursts against an under-"
+                        "provisioned page pool — preemption, shedding, "
+                        "and deadline scrubbing measured instead of "
+                        "idle — plus the decode_steps_per_tick x "
+                        "inflight_blocks operating-point table + knee; "
+                        "merges mixed_* keys into the JSON line")
 
     # multi-replica router: fronts N `butterfly serve` replicas with
     # prefix-affinity routing + health-aware failover (router/). Loads no
@@ -265,6 +275,87 @@ def build_parser() -> argparse.ArgumentParser:
                         "legs, deterministically per seed")
     slo_flags(f)  # declared objectives activate SLO accounting AND
     # SLO-aware admission shedding on every in-process replica
+
+    # workload subsystem (butterfly_tpu/workload/): generate seeded
+    # stochastic traffic traces, replay them open-loop at a live URL,
+    # and sweep scheduler operating points — the measurement substrate
+    # the mixed bench phase runs on.
+    w = sub.add_parser("workload",
+                       help="stochastic workload tooling: generate a "
+                            "seeded trace, replay one at a server "
+                            "open-loop, or sweep scheduler operating "
+                            "points")
+    wsub = w.add_subparsers(dest="wcmd", required=True)
+
+    def workload_shape_flags(sp, for_generate=True):
+        if for_generate:
+            sp.add_argument("--workload", default="mixed_chat",
+                            help="canned workload name "
+                                 "(mixed_chat, uniform)")
+            sp.add_argument("--n", type=int, default=32,
+                            help="requests to sample")
+            sp.add_argument("--seed", type=int, default=0)
+            sp.add_argument("--arrival", default="poisson:8",
+                            help="arrival process: poisson:<rate>, "
+                                 "burst:<rate_on>:<mean_on_s>:"
+                                 "<mean_off_s>[:<rate_off>], "
+                                 "ramp:<r0>:<r1>:<ramp_s>")
+            sp.add_argument("--vocab", type=int, default=258,
+                            help="token-id vocabulary (match the "
+                                 "target model; 258 = tiny)")
+            sp.add_argument("--page-size", type=int, default=16,
+                            help="prefix alignment unit — match the "
+                                 "server's --page-size")
+            sp.add_argument("--prompt-lo", type=int, default=32)
+            sp.add_argument("--prompt-hi", type=int, default=1024)
+            sp.add_argument("--max-new-lo", type=int, default=8)
+            sp.add_argument("--max-new-hi", type=int, default=256)
+            sp.add_argument("--deadline-ms", type=float, default=None,
+                            help="latency budget for the workload's "
+                                 "deadline-carrying cohort")
+
+    wg = wsub.add_parser("generate",
+                         help="sample a workload + arrival schedule "
+                              "into a JSONL trace")
+    workload_shape_flags(wg)
+    wg.add_argument("--out", required=True, metavar="FILE",
+                    help="trace output path (JSONL)")
+
+    wr = wsub.add_parser("replay",
+                         help="fire a saved trace at a live server/"
+                              "router URL with absolute-time fidelity "
+                              "(open loop)")
+    wr.add_argument("--trace", required=True, metavar="FILE")
+    wr.add_argument("--url", required=True,
+                    help="target base URL, e.g. http://127.0.0.1:8000")
+    wr.add_argument("--speed", type=float, default=1.0,
+                    help="schedule compression: 2.0 replays twice as "
+                         "fast")
+    wr.add_argument("--timeout", type=float, default=120.0)
+    wr.add_argument("--slo-ttft-ms", type=float, default=None)
+    wr.add_argument("--slo-itl-ms", type=float, default=None)
+
+    ws = wsub.add_parser("sweep",
+                         help="run one workload across a "
+                              "decode_steps_per_tick x inflight_blocks "
+                              "grid (in-process engine) and emit the "
+                              "latency/throughput table + knee")
+    workload_shape_flags(ws)
+    ws.add_argument("--model", default="tiny")
+    ws.add_argument("--quant", choices=["none", "int8"], default="none")
+    kv_quant_flag(ws)
+    ws.add_argument("--ckpt", default=None)
+    ws.add_argument("--grid", default="1,4x1,2",
+                    help="'<k1>,<k2>x<d1>,<d2>' decode_steps_per_tick "
+                         "x inflight_blocks values, full cross product")
+    ws.add_argument("--max-batch", type=int, default=8)
+    ws.add_argument("--num-pages", type=int, default=0,
+                    help="KV page pool size (0 = full provisioning; "
+                         "set below max_batch x pages-per-seq to "
+                         "measure preemption behavior)")
+    ws.add_argument("--slo-ttft-ms", type=float, default=None,
+                    help="arm SLO-aware admission shedding during the "
+                         "sweep (sheds are counted per point)")
     return p
 
 
@@ -454,6 +545,20 @@ def cmd_bench(args) -> int:
             isolated_decode_tok_s_chip=stats[
                 "decode_tokens_per_sec_per_chip"])
         stats.update(serving)
+    if getattr(args, "mixed", False):
+        # mixed-workload phase (ISSUE 10): mixed_chat open-loop bursts
+        # against an under-provisioned pool + the operating-point sweep
+        # (single-engine, like --serving)
+        from butterfly_tpu.obs.benchmark import run_mixed_benchmark
+        stats.update(run_mixed_benchmark(
+            model, params, n_requests=2 * args.batch,
+            max_batch=args.batch,
+            prompt_lo=max(8, args.prompt_len // 4),
+            prompt_hi=args.prompt_len,
+            max_new_lo=max(4, args.max_new // 4),
+            max_new_hi=args.max_new,
+            inflight_blocks=args.inflight_blocks,
+            kv_quant=args.kv_quant))
     print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/sec/chip", **stats}))
@@ -528,11 +633,79 @@ def cmd_fleet(args) -> int:
     return 0
 
 
+def cmd_workload(args) -> int:
+    """`butterfly workload generate|replay|sweep` (ISSUE 10): the
+    seeded traffic-modeling subsystem's CLI surface. generate/replay
+    are stdlib-fast (no engine); sweep builds an in-process engine."""
+    from butterfly_tpu.workload import (assign_arrivals, get_workload,
+                                        parse_arrival)
+    from butterfly_tpu.workload import replay as replay_mod
+
+    if args.wcmd == "generate":
+        wl = get_workload(args.workload, page_size=args.page_size,
+                          vocab=args.vocab, prompt_lo=args.prompt_lo,
+                          prompt_hi=args.prompt_hi,
+                          max_new_lo=args.max_new_lo,
+                          max_new_hi=args.max_new_hi,
+                          deadline_ms=args.deadline_ms)
+        specs = wl.sample(args.n, args.seed)
+        assign_arrivals(specs, parse_arrival(args.arrival), args.seed)
+        replay_mod.save_trace(args.out, specs, workload=wl,
+                              arrival=args.arrival, seed=args.seed)
+        cohorts = {}
+        for s in specs:
+            cohorts[s.cohort] = cohorts.get(s.cohort, 0) + 1
+        print(json.dumps({
+            "trace": str(args.out), "workload": wl.name, "n": len(specs),
+            "seed": args.seed, "arrival": args.arrival,
+            "cohorts": cohorts,
+            "prompt_tokens": sum(len(s.tokens) for s in specs),
+            "max_new_tokens": sum(s.max_new for s in specs),
+            "span_s": round(specs[-1].arrival_s, 3) if specs else 0.0}))
+        return 0
+    if args.wcmd == "replay":
+        _, specs = replay_mod.load_trace(args.trace)
+        stats = replay_mod.replay_trace(
+            args.url, specs, speed=args.speed, timeout=args.timeout,
+            slo_ttft_ms=args.slo_ttft_ms, slo_itl_ms=args.slo_itl_ms)
+        print(json.dumps(stats, indent=2))
+        # like loadgen: sheds/504s are requested backpressure; only
+        # transport errors / 5xx faults fail the replay
+        return 0 if stats["outcomes"]["error"] == 0 else 1
+    # sweep: in-process engine over the operating-point grid
+    import jax
+    from butterfly_tpu.core.config import PRESETS, tiny
+    from butterfly_tpu.models.common import Model
+    from butterfly_tpu.workload.sweep import (parse_grid,
+                                              run_operating_point_sweep)
+    cfg = tiny("llama", dtype="float32", param_dtype="float32") \
+        if args.model == "tiny" else PRESETS[args.model]()
+    model = Model(cfg)
+    params = load_params(model, args)
+    # the sweep drives a real engine, so the workload's vocabulary is
+    # the MODEL's (the --vocab flag applies to `generate`, whose trace
+    # may target any server)
+    wl = get_workload(args.workload, page_size=args.page_size,
+                      vocab=model.cfg.vocab_size,
+                      prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+                      max_new_lo=args.max_new_lo,
+                      max_new_hi=args.max_new_hi,
+                      deadline_ms=args.deadline_ms)
+    out = run_operating_point_sweep(
+        model, params, workload=wl, arrival=args.arrival,
+        n_requests=args.n, grid=parse_grid(args.grid),
+        max_batch=args.max_batch, num_pages=args.num_pages,
+        kv_quant=args.kv_quant, slo_ttft_ms=args.slo_ttft_ms,
+        seed=args.seed)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"generate": cmd_generate, "serve": cmd_serve,
             "bench": cmd_bench, "route": cmd_route,
-            "fleet": cmd_fleet}[args.cmd](args)
+            "fleet": cmd_fleet, "workload": cmd_workload}[args.cmd](args)
 
 
 if __name__ == "__main__":

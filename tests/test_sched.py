@@ -303,8 +303,14 @@ def test_inter_token_latency_metrics():
     r2 = sched.submit([3, 1], max_new_tokens=4)
     sched.run_until_done()
     m = sched.metrics()
-    assert {"itl_p50", "itl_p95", "itl_max"} <= set(m)
-    assert m["itl_p50"] >= 0 and m["itl_max"] >= m["itl_p50"]
+    # raw-gap percentiles live ONLY under the _tick_burst suffix
+    # (ISSUE 10: the bare itl_p50/itl_p95 keys published a degenerate
+    # 0.0 median under pipelined dispatch and were dropped)
+    assert {"itl_p50_tick_burst", "itl_p95_tick_burst",
+            "itl_max_tick_burst"} <= set(m)
+    assert not {"itl_p50", "itl_p95", "itl_max"} & set(m)
+    assert m["itl_p50_tick_burst"] >= 0
+    assert m["itl_max_tick_burst"] >= m["itl_p50_tick_burst"]
     # gaps = (6-1) + (4-1)
     assert len(sched._itls) == (len(r1.output) - 1) + (len(r2.output) - 1)
 

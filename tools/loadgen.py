@@ -1,43 +1,57 @@
 #!/usr/bin/env python3
-"""Closed-loop multi-client load generator for a serve replica or router.
+"""Load generator for a serve replica, router, or fleet control plane.
 
-stdlib-only (urllib + threading — no jax, no backend): each of
-``--clients`` worker threads keeps exactly ONE request in flight (issue,
-wait for the full response, repeat), the closed-loop shape that exercises
-continuous batching without open-loop queue explosion.
+stdlib-only (urllib + threading — no jax, no backend). Two drive modes:
 
-``--prefix-share R`` is the affinity workload knob: fraction of requests
-whose token prompt begins with a SHARED ``--shared-len``-token prefix
-(the "same system prompt" population). Pointed at a router, a high share
-should concentrate those requests on one replica and raise its
-prefix-cache hit counters; pointed straight at a replica it measures
-prefix-caching TTFT wins.
+* **Closed loop** (default): each of ``--clients`` worker threads keeps
+  exactly ONE request in flight (issue, wait, repeat) — exercises
+  continuous batching without open-loop queue explosion. The offered
+  rate is throttled by the server's own latency, so this mode can
+  never really force queue growth, shedding, or preemption.
+* **Open loop** (``--workload NAME`` or ``--trace FILE``): requests
+  fire on an absolute arrival schedule (``--arrival poisson:8``,
+  ``burst:...``, ``ramp:...`` — butterfly_tpu/workload/arrivals.py)
+  regardless of how earlier requests are faring. This is the
+  admission-control regime: load is no longer bounded by client count,
+  so the queue, the shed path, and the page pool actually get tested.
+  ``--save trace.jsonl`` persists the generated trace for replay.
 
-``--soak`` is the fleet mode: while the closed-loop load runs, every
-replica behind the router/control plane is rolled through
-drain -> (restart) -> undrain in sequence (``run_fleet_soak``); the
-pass property is zero dropped un-started requests, and against a
-disaggregated control plane the result also carries the
-/fleet/state transfer counters (kv_transfer_hit_rate, bytes, the
-disagg/direct split) and client-observed TTFT percentiles.
+Request firing and judging live in ``fire_one`` + ``Collector`` and are
+shared by both modes AND by the workload replay driver
+(butterfly_tpu/workload/replay.py) — one accounting implementation,
+every summary the same shape.
 
-``--slo-ttft-ms`` / ``--slo-itl-ms`` declare latency objectives: every
-request is judged client-side (TTFT and per-request mean ITL from the
-response body) and the summary reports ``slo_attainment`` — the
-fraction of successful requests that met every declared objective,
-the client-observed twin of the servers' slo_* counters.
+Every summary also scrapes the target's ``/metrics`` after the run and
+folds the server-side counters (``serving_preemptions``, ``shed_total``,
+``deadline_expired_total``) in under ``server``, so client-observed and
+server-counted outcomes are checked against each other in one artifact.
 
-Importable by tests (``run_load`` / ``run_fleet_soak``) and runnable
-standalone:
+``--prefix-share R`` (closed loop) is the affinity workload knob:
+fraction of requests whose token prompt begins with a SHARED
+``--shared-len``-token prefix. ``--soak`` is the fleet mode: while the
+closed-loop load runs, every replica behind the router/control plane is
+rolled through drain -> (restart) -> undrain (``run_fleet_soak``).
+``--slo-ttft-ms`` / ``--slo-itl-ms`` declare latency objectives judged
+client-side per request (``slo_attainment`` in the summary).
+
+Importable by tests (``run_load`` / ``run_fleet_soak`` / ``fire_one`` /
+``Collector``) and runnable standalone:
 
     python tools/loadgen.py --url http://127.0.0.1:8100 \
         --clients 8 --requests 16 --prefix-share 0.5 --json
+    python tools/loadgen.py --url http://127.0.0.1:8100 \
+        --workload mixed_chat --n 64 --arrival burst:20:0.5:2 --json
+
+The closed-loop path stays jax-free; the open-loop path imports
+butterfly_tpu.workload (stdlib itself, but the package import pulls the
+usual butterfly_tpu deps).
 """
 from __future__ import annotations
 
 import argparse
 import json
 import random
+import re
 import sys
 import threading
 import time
@@ -62,6 +76,204 @@ def shared_prefix(shared_len: int, seed: int = 0,
     return [rng.randrange(1, vocab) for _ in range(shared_len)]
 
 
+class Collector:
+    """Thread-safe per-request outcome accounting shared by the
+    closed-loop clients, the fleet soak, and the open-loop trace
+    replay (workload/replay.py) — TTFT/ITL/SLO verdicts and the
+    terminal-outcome breakdown live HERE, once.
+
+    Outcome semantics: an HTTP error IS a terminal outcome (the server
+    answered definitively) — 429 = shed/backpressure, 504 = deadline
+    exceeded; anything else is a fault. `terminal` counts requests that
+    got ANY definitive answer; the zero-hang property of a soak is
+    terminal == sent with outcomes["error"] == 0.
+    """
+
+    def __init__(self, slo_ttft_ms: Optional[float] = None,
+                 slo_itl_ms: Optional[float] = None):
+        self.lock = threading.Lock()
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_itl_ms = slo_itl_ms
+        self.slo_declared = slo_ttft_ms is not None or slo_itl_ms is not None
+        self.latencies: List[float] = []
+        self.ttfts: List[float] = []
+        self.shared_latencies: List[float] = []
+        self.by_replica: Dict[str, int] = {}
+        self.errors: List[str] = []
+        self.counts = {"sent": 0, "ok": 0, "shared": 0, "disaggregated": 0,
+                       "slo_ok": 0, "slo_ttft_ok": 0, "slo_itl_ok": 0}
+        self.outcomes = {"ok": 0, "shed_429": 0, "deadline_504": 0,
+                         "error": 0}
+
+    def record_ok(self, dt: float, ttft, total, n_toks: int,
+                  routed: Optional[str], disagg: bool,
+                  shared: bool = False) -> None:
+        # client-side SLO verdicts for this request: a response missing
+        # the fields its verdict needs counts as a miss — the client
+        # couldn't verify its SLO
+        ttft_ok = itl_ok = True
+        if self.slo_ttft_ms is not None:
+            ttft_ok = isinstance(ttft, (int, float)) \
+                and ttft * 1e3 <= self.slo_ttft_ms
+        if self.slo_itl_ms is not None and n_toks > 1 \
+                and isinstance(ttft, (int, float)) \
+                and isinstance(total, (int, float)):
+            itl_ok = ((total - ttft) / (n_toks - 1)
+                      * 1e3 <= self.slo_itl_ms)
+        elif self.slo_itl_ms is not None and (
+                not isinstance(total, (int, float))):
+            itl_ok = False
+        with self.lock:
+            self.counts["sent"] += 1
+            self.counts["ok"] += 1
+            self.outcomes["ok"] += 1
+            self.counts["shared"] += int(shared)
+            self.counts["disaggregated"] += int(disagg)
+            if self.slo_declared:
+                self.counts["slo_ttft_ok"] += int(ttft_ok)
+                self.counts["slo_itl_ok"] += int(itl_ok)
+                self.counts["slo_ok"] += int(ttft_ok and itl_ok)
+            self.latencies.append(dt)
+            if isinstance(ttft, (int, float)):
+                self.ttfts.append(float(ttft))
+            if shared:
+                self.shared_latencies.append(dt)
+            if routed:
+                self.by_replica[routed] = self.by_replica.get(routed, 0) + 1
+
+    def record_http_error(self, code: int, label: str) -> None:
+        with self.lock:
+            self.counts["sent"] += 1
+            if code == 429:
+                self.outcomes["shed_429"] += 1
+            elif code == 504:
+                self.outcomes["deadline_504"] += 1
+            else:
+                self.outcomes["error"] += 1
+                self.errors.append(f"{label}: http {code}")
+
+    def record_transport_error(self, err, label: str) -> None:
+        with self.lock:
+            self.counts["sent"] += 1
+            self.outcomes["error"] += 1
+            self.errors.append(f"{label}: {err}")
+
+    def summary(self, wall: float) -> Dict:
+        c, o = self.counts, self.outcomes
+        return {
+            "sent": c["sent"], "ok": c["ok"],
+            "failed": c["sent"] - c["ok"],
+            # terminal-outcome breakdown: every sent request lands in
+            # exactly one bucket; `terminal` excludes only transport
+            # errors/hangs
+            "outcomes": dict(o),
+            "terminal": o["ok"] + o["shed_429"] + o["deadline_504"],
+            "shared_prefix_requests": c["shared"],
+            "disaggregated": c["disaggregated"],
+            "wall_s": wall,
+            "rps": c["ok"] / wall if wall > 0 else 0.0,
+            "latency_p50_s": _percentile(self.latencies, 50),
+            "latency_p95_s": _percentile(self.latencies, 95),
+            "ttft_p50_s": _percentile(self.ttfts, 50),
+            "ttft_p95_s": _percentile(self.ttfts, 95),
+            "shared_latency_p50_s": _percentile(self.shared_latencies, 50),
+            "by_replica": dict(self.by_replica),
+            "errors": self.errors[:20],
+            "slo_ttft_ms": self.slo_ttft_ms,
+            "slo_itl_ms": self.slo_itl_ms,
+            "slo_attainment": (c["slo_ok"] / c["ok"]
+                               if self.slo_declared and c["ok"] else None),
+            "slo_ttft_ok": c["slo_ttft_ok"] if self.slo_declared else None,
+            "slo_itl_ok": c["slo_itl_ok"] if self.slo_declared else None,
+        }
+
+
+def fire_one(url: str, path: str, payload: Dict, timeout: float,
+             col: Collector, label: str = "req",
+             shared: bool = False) -> None:
+    """POST one request and record its outcome into `col`."""
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + path, data=body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            routed = resp.headers.get("X-Routed-To")
+        dt = time.monotonic() - t0
+        try:  # /generate bodies carry ttft_s (replica-measured direct,
+            # control-plane-measured across a disaggregated handoff)
+            # + the handoff marker
+            obj = json.loads(raw or b"{}")
+            ttft = obj.get("ttft_s")
+            disagg = bool(obj.get("disaggregated"))
+            n_toks = len(obj.get("tokens") or ())
+            total = obj.get("total_s")
+        except (ValueError, AttributeError):
+            ttft, disagg, n_toks, total = None, False, 0, None
+        col.record_ok(dt, ttft, total, n_toks, routed, disagg,
+                      shared=shared)
+    except urllib.error.HTTPError as e:
+        try:
+            e.read()
+        except OSError:
+            pass
+        e.close()
+        col.record_http_error(e.code, label)
+    except (urllib.error.URLError, OSError) as e:
+        col.record_transport_error(e, label)
+
+
+#: prometheus sample line: name{labels} value  (labels optional)
+_METRIC_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+#: server-side counter families folded into every loadgen/replay
+#: summary (labeled families sum over their children), keyed by the
+#: summary field name they land under
+_SERVER_FAMILIES = {
+    "serving_preemptions": "butterfly_preemptions_total",
+    "shed_total": "butterfly_shed_total",
+    "deadline_expired_total": "butterfly_deadline_expired_total",
+    "tokens_generated_total": "butterfly_tokens_generated_total",
+}
+
+
+def scrape_server_counters(url: str, timeout: float = 10.0) -> Dict:
+    """GET /metrics and fold the overload-protection counters into a
+    small dict, so a load run's JSON carries the SERVER-counted
+    outcomes next to the client-observed ones (a shed the client saw
+    as 429 should show up in shed_total; a preemption is invisible to
+    clients and ONLY shows up here). Families absent at the target
+    (e.g. a plain router's registry) read 0.0; an unreachable /metrics
+    reads {"scraped": False}."""
+    try:
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return {"scraped": False, "error": str(e)[:200]}
+    sums: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _METRIC_RE.match(line)
+        if not m:
+            continue
+        name, _, raw = m.groups()
+        try:
+            val = float(raw)
+        except ValueError:
+            continue
+        sums[name] = sums.get(name, 0.0) + val
+    out: Dict = {"scraped": True}
+    for field, family in _SERVER_FAMILIES.items():
+        out[field] = sums.get(family, 0.0)
+    return out
+
+
 def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
              prefix_share: float = 0.5, shared_len: int = 32,
              tail_len: int = 8, max_tokens: int = 8, seed: int = 0,
@@ -71,7 +283,8 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
              slo_itl_ms: Optional[float] = None,
              deadline_ms: Optional[float] = None,
              priority: Optional[str] = None,
-             speculative: Optional[bool] = None) -> Dict:
+             speculative: Optional[bool] = None,
+             scrape: bool = True) -> Dict:
     """Drive `url` closed-loop; returns aggregate stats.
 
     Every request uses token-id prompts (deterministic, tokenizer-free).
@@ -79,31 +292,14 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     per-request tail; the rest are fully private prompts of the same
     total length, so the two populations differ only in shareability.
 
-    With declared objectives (`slo_ttft_ms` / `slo_itl_ms`) every
-    request is judged CLIENT-SIDE against them — TTFT from the body's
-    `ttft_s`, mean ITL from `(total_s - ttft_s)/(tokens - 1)` — and the
-    summary carries `slo_attainment`, the fraction of OK responses that
-    met every declared objective (a response missing the fields it
-    needs counts as a miss: the client couldn't verify its SLO).
-
     `deadline_ms` stamps a latency budget on every request (the server
     504s whatever blows it); `priority` tags the admission class
-    ('interactive'/'batch'; batch sheds first under load). The summary's
-    `outcomes` dict is the TERMINAL-OUTCOME breakdown — ok / shed_429 /
-    deadline_504 / error — so a soak shows shedding and expiry instead
-    of hiding them inside `failed`; `terminal` counts requests that got
-    ANY definitive answer (everything but transport errors/hangs)."""
+    ('interactive'/'batch'; batch sheds first under load). Outcome /
+    SLO semantics live in `Collector`; the summary additionally carries
+    the post-run server-side counters under ``server``
+    (`scrape_server_counters`)."""
     prefix = shared_prefix(shared_len, seed, vocab)
-    lock = threading.Lock()
-    latencies: List[float] = []
-    ttfts: List[float] = []
-    shared_latencies: List[float] = []
-    by_replica: Dict[str, int] = {}
-    errors: List[str] = []
-    counts = {"sent": 0, "ok": 0, "shared": 0, "disaggregated": 0,
-              "slo_ok": 0, "slo_ttft_ok": 0, "slo_itl_ok": 0}
-    outcomes = {"ok": 0, "shed_429": 0, "deadline_504": 0, "error": 0}
-    slo_declared = slo_ttft_ms is not None or slo_itl_ms is not None
+    col = Collector(slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
 
     def one_client(cid: int) -> None:
         rng = random.Random(seed * 1000 + cid)
@@ -123,79 +319,8 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
                 payload["priority"] = priority
             if speculative is not None:
                 payload["speculative"] = speculative
-            body = json.dumps(payload).encode()
-            req = urllib.request.Request(
-                url + path, data=body,
-                headers={"Content-Type": "application/json"})
-            t0 = time.monotonic()
-            try:
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    raw = resp.read()
-                    routed = resp.headers.get("X-Routed-To")
-                dt = time.monotonic() - t0
-                try:  # /generate bodies carry ttft_s (replica-measured
-                    # direct, control-plane-measured across a
-                    # disaggregated handoff) + the handoff marker
-                    obj = json.loads(raw or b"{}")
-                    ttft = obj.get("ttft_s")
-                    disagg = bool(obj.get("disaggregated"))
-                    n_toks = len(obj.get("tokens") or ())
-                    total = obj.get("total_s")
-                except (ValueError, AttributeError):
-                    ttft, disagg, n_toks, total = None, False, 0, None
-                # client-side SLO verdicts for this request
-                ttft_ok = itl_ok = True
-                if slo_ttft_ms is not None:
-                    ttft_ok = isinstance(ttft, (int, float)) \
-                        and ttft * 1e3 <= slo_ttft_ms
-                if slo_itl_ms is not None and n_toks > 1 \
-                        and isinstance(ttft, (int, float)) \
-                        and isinstance(total, (int, float)):
-                    itl_ok = ((total - ttft) / (n_toks - 1)
-                              * 1e3 <= slo_itl_ms)
-                elif slo_itl_ms is not None and (
-                        not isinstance(total, (int, float))):
-                    itl_ok = False
-                with lock:
-                    counts["sent"] += 1
-                    counts["ok"] += 1
-                    outcomes["ok"] += 1
-                    counts["shared"] += int(is_shared)
-                    counts["disaggregated"] += int(disagg)
-                    if slo_declared:
-                        counts["slo_ttft_ok"] += int(ttft_ok)
-                        counts["slo_itl_ok"] += int(itl_ok)
-                        counts["slo_ok"] += int(ttft_ok and itl_ok)
-                    latencies.append(dt)
-                    if isinstance(ttft, (int, float)):
-                        ttfts.append(float(ttft))
-                    if is_shared:
-                        shared_latencies.append(dt)
-                    if routed:
-                        by_replica[routed] = by_replica.get(routed, 0) + 1
-            except urllib.error.HTTPError as e:
-                # an HTTP error IS a terminal outcome: the server
-                # answered definitively. 429 = shed/backpressure,
-                # 504 = deadline exceeded; anything else is a fault.
-                try:
-                    e.read()
-                except OSError:
-                    pass
-                e.close()
-                with lock:
-                    counts["sent"] += 1
-                    if e.code == 429:
-                        outcomes["shed_429"] += 1
-                    elif e.code == 504:
-                        outcomes["deadline_504"] += 1
-                    else:
-                        outcomes["error"] += 1
-                        errors.append(f"client{cid}#{i}: http {e.code}")
-            except (urllib.error.URLError, OSError) as e:
-                with lock:
-                    counts["sent"] += 1
-                    outcomes["error"] += 1
-                    errors.append(f"client{cid}#{i}: {e}")
+            fire_one(url, path, payload, timeout, col,
+                     label=f"client{cid}#{i}", shared=is_shared)
 
     t_start = time.monotonic()
     threads = [threading.Thread(target=one_client, args=(c,))
@@ -204,35 +329,10 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
         t.start()
     for t in threads:
         t.join()
-    wall = time.monotonic() - t_start
-    return {
-        "sent": counts["sent"], "ok": counts["ok"],
-        "failed": counts["sent"] - counts["ok"],
-        # terminal-outcome breakdown: every sent request lands in
-        # exactly one bucket; `terminal` excludes only transport
-        # errors/hangs — the chaos soak's zero-hang property is
-        # terminal == sent with outcomes["error"] == 0
-        "outcomes": dict(outcomes),
-        "terminal": outcomes["ok"] + outcomes["shed_429"]
-                    + outcomes["deadline_504"],
-        "shared_prefix_requests": counts["shared"],
-        "disaggregated": counts["disaggregated"],
-        "wall_s": wall,
-        "rps": counts["ok"] / wall if wall > 0 else 0.0,
-        "latency_p50_s": _percentile(latencies, 50),
-        "latency_p95_s": _percentile(latencies, 95),
-        "ttft_p50_s": _percentile(ttfts, 50),
-        "ttft_p95_s": _percentile(ttfts, 95),
-        "shared_latency_p50_s": _percentile(shared_latencies, 50),
-        "by_replica": by_replica,
-        "errors": errors[:20],
-        "slo_ttft_ms": slo_ttft_ms,
-        "slo_itl_ms": slo_itl_ms,
-        "slo_attainment": (counts["slo_ok"] / counts["ok"]
-                           if slo_declared and counts["ok"] else None),
-        "slo_ttft_ok": counts["slo_ttft_ok"] if slo_declared else None,
-        "slo_itl_ok": counts["slo_itl_ok"] if slo_declared else None,
-    }
+    out = col.summary(time.monotonic() - t_start)
+    if scrape:
+        out["server"] = scrape_server_counters(url)
+    return out
 
 
 def _get_json(url: str, path: str, timeout: float = 10.0) -> Dict:
@@ -328,20 +428,71 @@ def run_fleet_soak(url: str, clients: int = 4,
     return result
 
 
+def _workload_modules():
+    """Lazy import of the workload subsystem (open-loop mode only —
+    the closed-loop path stays importable without the package). Running
+    the script from outside the repo root still resolves: fall back to
+    inserting the repo root on sys.path."""
+    try:
+        from butterfly_tpu.workload import arrivals, models, replay
+    except ImportError:
+        from pathlib import Path
+        sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from butterfly_tpu.workload import arrivals, models, replay
+    return models, arrivals, replay
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
-        description="closed-loop load generator for butterfly serve/route")
+        description="load generator for butterfly serve/route "
+                    "(closed-loop clients, or open-loop workload/trace "
+                    "replay)")
     ap.add_argument("--url", required=True,
                     help="base URL, e.g. http://127.0.0.1:8100")
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--requests", type=int, default=8,
-                    help="requests per client")
+                    help="requests per client (closed loop)")
     ap.add_argument("--prefix-share", type=float, default=0.5)
     ap.add_argument("--shared-len", type=int, default=32)
     ap.add_argument("--tail-len", type=int, default=8)
     ap.add_argument("--max-tokens", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--path", default="/generate")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    # -- open-loop workload mode ------------------------------------------
+    ap.add_argument("--workload", default=None, metavar="NAME",
+                    help="OPEN-LOOP mode: generate this canned workload "
+                         "(butterfly_tpu/workload: mixed_chat, uniform) "
+                         "and fire it on the --arrival schedule instead "
+                         "of running closed-loop clients")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="OPEN-LOOP mode: replay a saved JSONL trace "
+                         "(butterfly workload generate / --save) with "
+                         "absolute-time fidelity")
+    ap.add_argument("--arrival", default="poisson:8",
+                    help="arrival process for --workload: poisson:<rate>"
+                         ", burst:<rate_on>:<mean_on_s>:<mean_off_s>"
+                         "[:<rate_off>], or ramp:<r0>:<r1>:<ramp_s>")
+    ap.add_argument("--n", type=int, default=32,
+                    help="total requests to generate for --workload")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="replay time compression: 2.0 fires a trace's "
+                         "schedule twice as fast")
+    ap.add_argument("--save", default=None, metavar="FILE",
+                    help="with --workload: also save the generated "
+                         "trace as JSONL before firing it")
+    ap.add_argument("--vocab", type=int, default=258,
+                    help="workload token-id vocabulary (match the "
+                         "model; 258 = tiny/ByteTokenizer)")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="workload prefix alignment unit — match the "
+                         "server's --page-size so shared prefixes land "
+                         "whole pages")
+    ap.add_argument("--prompt-lo", type=int, default=32)
+    ap.add_argument("--prompt-hi", type=int, default=1024)
+    ap.add_argument("--max-new-lo", type=int, default=8)
+    ap.add_argument("--max-new-hi", type=int, default=256)
+    # -- shared knobs ------------------------------------------------------
     ap.add_argument("--slo-ttft-ms", type=float, default=None,
                     help="declared TTFT objective: judge every request "
                          "client-side and report slo_attainment")
@@ -371,13 +522,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
-    if args.soak:
+    if args.trace and args.workload:
+        ap.error("--trace and --workload are mutually exclusive")
+    if args.trace or args.workload:
+        if args.soak:
+            ap.error("--soak is a closed-loop fleet mode; open-loop "
+                     "workload replay does its own pacing")
+        models, arrivals, replay = _workload_modules()
+        if args.trace:
+            _, specs = replay.load_trace(args.trace)
+        else:
+            wl = models.get_workload(
+                args.workload, page_size=args.page_size,
+                vocab=args.vocab, prompt_lo=args.prompt_lo,
+                prompt_hi=args.prompt_hi, max_new_lo=args.max_new_lo,
+                max_new_hi=args.max_new_hi,
+                deadline_ms=args.deadline_ms)
+            specs = wl.sample(args.n, args.seed)
+            arrivals.assign_arrivals(
+                specs, arrivals.parse_arrival(args.arrival), args.seed)
+            if args.priority is not None:
+                for s in specs:
+                    s.priority = args.priority
+            if args.speculative is not None:
+                for s in specs:
+                    s.speculative = args.speculative == "on"
+            if args.save:
+                replay.save_trace(args.save, specs, workload=wl,
+                                  arrival=args.arrival, seed=args.seed)
+        stats = replay.replay_trace(
+            args.url, specs, path=args.path, timeout=args.timeout,
+            speed=args.speed, slo_ttft_ms=args.slo_ttft_ms,
+            slo_itl_ms=args.slo_itl_ms)
+    elif args.soak:
         stats = run_fleet_soak(args.url, clients=args.clients,
                                requests_per_client=args.requests,
                                prefix_share=args.prefix_share,
                                shared_len=args.shared_len,
                                tail_len=args.tail_len,
                                max_tokens=args.max_tokens, seed=args.seed,
+                               timeout=args.timeout,
                                slo_ttft_ms=args.slo_ttft_ms,
                                slo_itl_ms=args.slo_itl_ms,
                                deadline_ms=args.deadline_ms,
@@ -390,7 +574,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          prefix_share=args.prefix_share,
                          shared_len=args.shared_len, tail_len=args.tail_len,
                          max_tokens=args.max_tokens, seed=args.seed,
-                         path=args.path, slo_ttft_ms=args.slo_ttft_ms,
+                         path=args.path, timeout=args.timeout,
+                         slo_ttft_ms=args.slo_ttft_ms,
                          slo_itl_ms=args.slo_itl_ms,
                          deadline_ms=args.deadline_ms,
                          priority=args.priority,
@@ -411,6 +596,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"slo attainment={stats['slo_attainment']:.3f} "
                   f"(ttft_ok={stats['slo_ttft_ok']}/{stats['ok']}, "
                   f"itl_ok={stats['slo_itl_ok']}/{stats['ok']})")
+        srv = stats.get("server") or {}
+        if srv.get("scraped"):
+            print(f"server counters: preemptions="
+                  f"{srv['serving_preemptions']:.0f} "
+                  f"shed={srv['shed_total']:.0f} "
+                  f"deadline_expired={srv['deadline_expired_total']:.0f}")
         if stats["by_replica"]:
             print("by replica: " + ", ".join(
                 f"{rid}={n}" for rid, n in

@@ -156,6 +156,14 @@ MUTANTS = [
      "vals[slot] = len(req.all_tokens) - 1",
      "vals[slot] = len(req.all_tokens)",
      ["tests/test_sched.py"], {}),
+    # workload generator: the Poisson arrival process ignores its rate
+    # (every open-loop bench/sweep would silently offer ~1 req/s
+    # regardless of the requested load) — the arrival-statistics test
+    # must pin the mean inter-arrival to 1/rate
+    ("butterfly_tpu/workload/arrivals.py",
+     "dt = rng.expovariate(self.rate)",
+     "dt = rng.expovariate(1.0)",
+     ["tests/test_workload.py"], {}),
 ]
 
 
