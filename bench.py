@@ -31,7 +31,27 @@ from pathlib import Path
 BASELINE_FILE = Path(__file__).parent / "bench_baseline.json"
 
 
+def lint_preflight():
+    """Run the project static analyzer (tools/staticcheck.py, ISSUE 11)
+    over the default trees; returns the unsuppressed findings. A bench
+    JSON published from a tree that violates the donation/lock/
+    host-sync/determinism contracts would certify numbers the serving
+    path can't be trusted to have produced — main() refuses."""
+    tools = str(Path(__file__).parent / "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import staticcheck
+    return staticcheck.run_default()
+
+
 def main() -> int:
+    lint = lint_preflight()
+    if lint:
+        print("bench: refusing to run on a tree with unsuppressed "
+              "staticcheck findings:", file=sys.stderr)
+        for f in lint:
+            print("  " + f.render(), file=sys.stderr)
+        return 2
     import jax
     from butterfly_tpu.core.config import llama3_8b, tiny
     from butterfly_tpu.models.common import Model
@@ -187,6 +207,9 @@ def main() -> int:
             round(stats["decode_tokens_per_sec_per_chip"], 2),
         "hbm_util": round(stats["hbm_util"], 4),
         "mfu": round(stats["mfu"], 4),
+        # the preflight refused above unless this is 0: the trajectory
+        # records the tree staying contract-clean round over round
+        "staticcheck_findings_total": len(lint),
     }
     for k, v in serving.items():
         out[k] = round(v, 4) if isinstance(v, float) else v

@@ -46,6 +46,7 @@ def load_sharded(path: str, cfg: ModelConfig, mesh=None) -> Any:
     from butterfly_tpu.models.common import Model
 
     p = Path(path).absolute()
+    # btf: disable=BTF006 shape-only eval_shape trace; no values drawn
     shapes = jax.eval_shape(
         lambda: Model(cfg).init(jax.random.PRNGKey(0)))
     if mesh is not None:

@@ -181,6 +181,7 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
     n_pre, n_dec = parse_topology(topology)
     if model is None:
         model = Model(tiny("llama", dtype="float32", param_dtype="float32"))
+        # btf: disable=BTF006 replicas must share one identical param tree (KV bytes interchangeable)
         params = model.init(jax.random.PRNGKey(0))
     roles = ["prefill"] * n_pre + ["decode"] * n_dec
     if not roles:

@@ -7,6 +7,7 @@
     butterfly bench    --model tiny [--serving --mixed]
     butterfly route    --backends 10.0.0.1:8000,10.0.0.2:8000
     butterfly workload generate|replay|sweep   (workload subsystem)
+    butterfly lint     [paths...]   (project-native static analysis)
 
 Models load from --ckpt (HF safetensors dir or our sharded checkpoint);
 without --ckpt, weights are random-initialized (smoke/demo mode).
@@ -356,6 +357,27 @@ def build_parser() -> argparse.ArgumentParser:
     ws.add_argument("--slo-ttft-ms", type=float, default=None,
                     help="arm SLO-aware admission shedding during the "
                          "sweep (sheds are counted per point)")
+
+    # project-native static analysis (tools/staticcheck.py, ISSUE 11):
+    # the donation/lock/host-sync/determinism contracts as AST rules —
+    # the same walk the tier-1 test and bench.py's preflight run.
+    li = sub.add_parser("lint",
+                        help="AST lint for the serving contracts "
+                             "(donation, locks, host-sync, HTTP "
+                             "timeouts, determinism, PRNG hygiene); "
+                             "exit 1 on any unsuppressed finding")
+    li.add_argument("paths", nargs="*",
+                    help="files/trees to lint (default: butterfly_tpu "
+                         "tools tests, fixture snippets excluded)")
+    li.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog (id, slug, scope, "
+                         "invariant) and exit")
+    li.add_argument("--json", action="store_true",
+                    help="machine-readable jsonl findings")
+    li.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    li.add_argument("--force", action="store_true",
+                    help="ignore per-rule scopes (ad-hoc sweeps)")
     return p
 
 
@@ -382,6 +404,7 @@ def load_params(model, args):
         from butterfly_tpu.ckpt import load_checkpoint
         params = load_checkpoint(args.ckpt, model.cfg)
     else:
+        # btf: disable=BTF006 demo mode: no-ckpt random-init weights are deliberately identical across runs
         params = model.init(jax.random.PRNGKey(0))
     if getattr(args, "quant", "none") == "int8":
         from butterfly_tpu.quant import quantize_int8
@@ -701,11 +724,42 @@ def cmd_workload(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """`butterfly lint`: the project-native static analyzer
+    (tools/staticcheck.py) from the package entrypoint. The analyzer
+    lives with the repo's tooling, not inside the wheel — a source
+    checkout is where the contracts it enforces exist."""
+    import importlib
+    from pathlib import Path
+
+    tools = Path(__file__).resolve().parent.parent.parent / "tools"
+    if not (tools / "staticcheck.py").exists():
+        print("error: butterfly lint needs the repo's tools/ directory "
+              "(run from a source checkout)", file=sys.stderr)
+        return 2
+    sys.path.insert(0, str(tools))
+    try:
+        staticcheck = importlib.import_module("staticcheck")
+    finally:
+        sys.path.remove(str(tools))
+    argv = list(args.paths)
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.json:
+        argv.append("--json")
+    if args.show_suppressed:
+        argv.append("--show-suppressed")
+    if args.force:
+        argv.append("--force")
+    return staticcheck.main(argv)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"generate": cmd_generate, "serve": cmd_serve,
             "bench": cmd_bench, "route": cmd_route,
-            "fleet": cmd_fleet, "workload": cmd_workload}[args.cmd](args)
+            "fleet": cmd_fleet, "workload": cmd_workload,
+            "lint": cmd_lint}[args.cmd](args)
 
 
 if __name__ == "__main__":

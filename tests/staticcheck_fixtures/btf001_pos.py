@@ -1,0 +1,18 @@
+"""BTF001 positive fixture: outbound HTTP calls with no timeout.
+
+Expected findings: 3 (urlopen, HTTPConnection, HTTPSConnection —
+including a multi-line call the old string-span grep handled only via
+a hand-rolled paren scan).
+"""
+import http.client
+import urllib.request
+
+
+def probe(url, host, port, headers):
+    resp = urllib.request.urlopen(url)                       # 1
+    conn = http.client.HTTPConnection(host, port)            # 2
+    conn2 = http.client.HTTPSConnection(
+        host,
+        port,
+    )                                                        # 3
+    return resp, conn, conn2

@@ -1,0 +1,42 @@
+"""BTF002 negative fixture: the blessed donation patterns — rebind in
+the same statement, rebind before the next read, factory programs, and
+the engine's self.cache = cache idiom. Expected findings: 0."""
+import jax
+
+
+def _step(params, toks, cache):
+    return toks, toks, cache
+
+
+class Engine:
+    def __init__(self):
+        self._decode = jax.jit(_step, donate_argnums=(2,))
+        self._progs = {}
+
+    def _prog(self, k):
+        prog = self._progs.get(k)
+        if prog is None:
+            prog = jax.jit(_step, donate_argnums=(2,))
+            self._progs[k] = prog
+        return prog
+
+    def same_statement_rebind(self, params, toks, cache):
+        nxt, logits, cache = self._decode(params, toks, cache)
+        return nxt, cache.lengths       # rebound: reads the NEW buffer
+
+    def attr_rebind(self, params, toks):
+        nxt, logits, cache = self._decode(params, toks, self.cache)
+        self.cache = cache              # store clears the poison
+        return nxt, self.cache.lengths
+
+    def factory_inline(self, params, toks, k):
+        nxt, logits, cache = self._prog(k)(params, toks, self.cache)
+        self.cache = cache
+        return nxt
+
+    def chained_loop(self, params, toks, cache):
+        out = []
+        for _ in range(4):
+            nxt, logits, cache = self._decode(params, toks, cache)
+            out.append(nxt)
+        return out, cache

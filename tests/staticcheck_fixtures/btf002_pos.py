@@ -1,0 +1,36 @@
+"""BTF002 positive fixture: reads of donated references after dispatch.
+
+Expected findings: 3 —
+* a read of the donated cache in the statement after the dispatch,
+* the same handle re-passed on the next loop iteration without rebind,
+* a read of a tree donated to a locally-built donating jit.
+"""
+import jax
+
+
+def _step(params, toks, cache):
+    return toks, toks, cache
+
+
+class Engine:
+    def __init__(self):
+        self._decode = jax.jit(_step, donate_argnums=(2,))
+
+    def read_after_dispatch(self, params, toks, cache):
+        nxt, logits, new_cache = self._decode(params, toks, cache)
+        return nxt, cache.lengths                     # finding 1
+
+    def stale_loop_operand(self, params, toks, cache):
+        out = []
+        for _ in range(4):
+            # donates `cache` but rebinds `cache2`: iteration t+1
+            # passes the freed buffer again
+            nxt, logits, cache2 = self._decode(params, toks, cache)
+            out.append(nxt)                           # finding 2 (cache)
+        return out
+
+
+def local_jit(tree):
+    cast = jax.jit(lambda p: p, donate_argnums=(0,))
+    out = cast(tree)
+    return out, tree                                  # finding 3

@@ -1,0 +1,29 @@
+"""BTF003 negative fixture: the same sync primitives OUTSIDE the hot
+set (the drain is where synchronization belongs), and the blessed
+host->host operand assembly inside a hot function. Expected findings: 0.
+"""
+from typing import List
+
+import numpy as np
+
+
+class Sched:
+    def tick(self):
+        # operand assembly from host lists is host->host, not a sync
+        temps = np.asarray([r.temperature for r in self.running])
+        active = np.zeros((8,), bool)
+        return self._decode_block(4), temps, active
+
+    def _decode_block(self, k: int):
+        budgets = np.maximum(self._base - k, 0)   # numpy math, no fetch
+        return budgets
+
+    def prefill_batch(self, slots: List[int], chunks: list):
+        # annotated host-container params: asarray over them is assembly
+        rows = np.asarray(slots, np.int32)
+        return rows
+
+    def _drain_blocks(self, blocks):
+        # the drain is the one blessed fetch point (not a hot function)
+        vals = np.asarray(self._pending)
+        return vals.tolist(), int(vals[0])

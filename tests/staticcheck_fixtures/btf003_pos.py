@@ -1,0 +1,18 @@
+"""BTF003 positive fixture: host syncs inside hot functions.
+
+Expected findings: 5 — .item(), .tolist(), np.asarray on a non-literal,
+jax.device_get, and int() over a device-carry name, all inside tick().
+"""
+import jax
+import numpy as np
+
+
+class Sched:
+    def tick(self):
+        logits = self.engine.last_logits
+        tok = int(logits[0])                      # 1: int over device name
+        arr = np.asarray(self.engine.carry)       # 2: non-literal asarray
+        val = self._probe_dev.item()              # 3: .item()
+        lst = self._next_dev.tolist()             # 4: .tolist()
+        jax.device_get(logits)                    # 5: device_get
+        return tok, arr, val, lst

@@ -1,0 +1,32 @@
+"""BTF004 positive fixture: lock-discipline violations.
+
+Expected findings: 5 — an unbounded .acquire(), network I/O under a
+lock, a raw `with state.lock:` in a handler class, and two unlocked
+instrument writes in a handler class.
+"""
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+
+class State:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def bad_acquire(self):
+        self.lock.acquire()                                  # 1
+
+    def bad_io(self, url):
+        with self.lock:
+            urllib.request.urlopen(url, timeout=1.0)         # 2
+
+
+def make_handler(state):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            with state.lock:                                 # 3
+                n = len(state.waiting)
+            state._c_requests.inc()                          # 4
+            state._g_depth.set(n)                            # 5
+
+    return Handler

@@ -1,0 +1,15 @@
+"""BTF005 negative fixture: the seeded-substream discipline the
+workload subsystem actually uses. Expected findings: 0."""
+import random
+import time
+
+import numpy as np
+
+
+def seeded_arrivals(seed, n):
+    rng = random.Random((seed << 1) ^ 0xA55A)    # seeded constructor
+    times = [rng.expovariate(8.0) for _ in range(n)]  # instance draws
+    gen = np.random.default_rng(seed)            # seeded numpy
+    t0 = time.monotonic()                        # elapsed, not wall
+    time.sleep(0.0)
+    return times, gen.normal(), time.monotonic() - t0

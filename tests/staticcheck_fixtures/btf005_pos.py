@@ -1,0 +1,22 @@
+"""BTF005 positive fixture: nondeterminism in trace-feeding code.
+
+Expected findings: 6 — a module-global random draw, an unseeded
+random.Random(), a wall-clock read, uuid4, os.urandom, and a numpy
+global-state draw.
+"""
+import os
+import random
+import time
+import uuid
+
+import numpy as np
+
+
+def jittered_arrival(rate):
+    dt = random.expovariate(rate)            # 1: global PRNG
+    rng = random.Random()                    # 2: unseeded
+    t0 = time.time()                         # 3: wall clock
+    rid = uuid.uuid4()                       # 4: entropy
+    salt = os.urandom(8)                     # 5: entropy
+    noise = np.random.normal()               # 6: numpy global state
+    return dt, rng, t0, rid, salt, noise

@@ -1,0 +1,28 @@
+"""BTF006 negative fixture: the split/fold_in discipline the engine
+uses. Expected findings: 0."""
+import jax
+
+
+def split_per_draw(logits, key):
+    key, sub = jax.random.split(key)
+    a = jax.random.categorical(sub, logits)
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (4,))
+    return a, b
+
+
+def split_per_iteration(logits, key):
+    out = []
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.categorical(sub, logits))
+    return out
+
+
+def derived_in_scan(logits, key, i):
+    # fold_in derives a fresh key per step — not a reuse of `key`
+    return jax.random.categorical(jax.random.fold_in(key, i), logits)
+
+
+def seeded(seed):
+    return jax.random.PRNGKey(seed)              # variable seed: fine

@@ -1,0 +1,23 @@
+"""BTF006 positive fixture: PRNG key indiscipline in sampling code.
+
+Expected findings: 3 — a key consumed by two draws without a split, the
+same key consumed once per loop iteration, and a constant PRNGKey.
+"""
+import jax
+
+
+def correlated_draws(logits, key):
+    a = jax.random.categorical(key, logits)
+    b = jax.random.uniform(key, (4,))            # 1: reuse
+    return a, b
+
+
+def loop_reuse(logits, key):
+    out = []
+    for _ in range(4):
+        out.append(jax.random.categorical(key, logits))  # 2: reuse/iter
+    return out
+
+
+def fixed_stream():
+    return jax.random.PRNGKey(0)                 # 3: constant key

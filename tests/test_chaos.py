@@ -2,12 +2,12 @@
 
 Pure-host fast tier: the seeded fault plan's determinism and
 validation, the replica-pool circuit breaker's open/half-open/close
-cycle, and the outbound-HTTP-timeout hygiene check. The system-level
+cycle, and the shim pinning the outbound-HTTP-timeout hygiene check's
+migration to the static analyzer (BTF001). The system-level
 chaos soak (faulted 2p2d fleet under loadgen) lives in test_fleet.py
 (slow tier); deadline/shed scheduler behavior in test_sched.py; the
 HTTP 504/429 surfaces in test_server.py.
 """
-import re
 from pathlib import Path
 
 import pytest
@@ -194,33 +194,26 @@ def test_breaker_open_tier_empties_candidates():
 # hygiene: every outbound HTTP call carries an explicit timeout
 # ---------------------------------------------------------------------------
 
-def _call_spans(text, name):
-    """Yield the argument span of every `name(...)` call in `text`
-    (balanced-paren scan, enough for call sites in this codebase)."""
-    for m in re.finditer(re.escape(name) + r"\(", text):
-        depth, i = 1, m.end()
-        while i < len(text) and depth:
-            depth += {"(": 1, ")": -1}.get(text[i], 0)
-            i += 1
-        yield text[m.start():i]
-
-
-def test_every_outbound_http_call_has_timeout():
-    """A urlopen/HTTPConnection call without an explicit timeout waits
-    on the OS default (minutes to forever) — one wedged peer then pins
-    a thread invisibly. Every outbound call in the package and tools
-    must carry one (the stray urlopen(..., timeout=5.0) this rule
-    replaced is why fleet side channels now share probe_timeout)."""
-    root = Path(__file__).parent.parent
-    offenders = []
-    for base in ("butterfly_tpu", "tools"):
-        for path in sorted((root / base).rglob("*.py")):
-            text = path.read_text()
-            for name in ("urlopen", "HTTPConnection"):
-                for span in _call_spans(text, name):
-                    if "timeout" not in span:
-                        offenders.append(f"{path.relative_to(root)}: "
-                                         f"{span[:80]!r}")
-    assert not offenders, (
-        "outbound HTTP calls without an explicit timeout:\n"
-        + "\n".join(offenders))
+def test_http_timeout_rule_replaces_string_span_check():
+    """RETIRED (ISSUE 11): the balanced-paren string-span scan this
+    file carried since PR 8 is replaced by the AST rule BTF001
+    (tools/staticrules/http_timeout.py), enforced repo-wide by
+    tests/test_staticcheck.py::test_repo_tree_lints_clean. This shim
+    pins the replacement so coverage can never silently narrow: the
+    rule must stay registered, walk AT LEAST the same trees the old
+    grep walked (butterfly_tpu/ + tools/), and cover at least the same
+    call names (urlopen/HTTPConnection — it added HTTPSConnection)."""
+    import sys
+    sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+    try:
+        import staticrules
+        from staticrules.http_timeout import TIMEOUT_ARG_INDEX
+    finally:
+        sys.path.pop(0)
+    rule = staticrules.RULES["BTF001"]
+    assert rule.name == "outbound-http-timeout"
+    for tree in ("butterfly_tpu", "tools"):  # the old grep's trees
+        assert rule.applies(f"{tree}/anything/deep.py"), \
+            f"BTF001 no longer walks {tree}/ — coverage narrowed"
+    assert {"urlopen", "HTTPConnection"} <= set(TIMEOUT_ARG_INDEX), \
+        "BTF001 dropped a call name the old string check covered"

@@ -164,6 +164,40 @@ MUTANTS = [
      "dt = rng.expovariate(self.rate)",
      "dt = rng.expovariate(1.0)",
      ["tests/test_workload.py"], {}),
+    # -- static-analyzer mutants (ISSUE 11): weaken one predicate per
+    # rule; the fixture suite's EXACT positive counts must fail. The
+    # checker is mutation-tested like the kernels — a rule that stops
+    # firing must never pass silently.
+    # BTF001: accept any keyword list as "has a timeout"
+    ("tools/staticrules/http_timeout.py",
+     'if any(kw.arg == "timeout" for kw in node.keywords):',
+     "if node.keywords or not node.keywords:",
+     ["tests/test_staticcheck.py"], {}),
+    # BTF002: donating calls stop poisoning their arguments
+    ("tools/staticrules/donation.py",
+     "poison = poison | self._donated_handles(stmt)",
+     "poison = poison | set()",
+     ["tests/test_staticcheck.py"], {}),
+    # BTF003: .item() dropped from the sync markers
+    ("tools/staticrules/host_sync.py",
+     'if name in ("item", "tolist", "block_until_ready") and \\',
+     'if name in ("tolist", "block_until_ready") and \\',
+     ["tests/test_staticcheck.py"], {}),
+    # BTF004: every .acquire() counts as bounded
+    ("tools/staticrules/locks.py",
+     'if any(kw.arg == "timeout" for kw in node.keywords) or \\',
+     "if (node.keywords is not None) or \\",
+     ["tests/test_staticcheck.py"], {}),
+    # BTF005: wall-clock reads allowed
+    ("tools/staticrules/determinism.py",
+     'if dotted == "time.time":',
+     'if dotted == "time.time_never":',
+     ["tests/test_staticcheck.py"], {}),
+    # BTF006: key reuse never flagged
+    ("tools/staticrules/prng.py",
+     "if h in consumed or h in new:",
+     "if h in consumed and h in new:",
+     ["tests/test_staticcheck.py"], {}),
 ]
 
 

@@ -1,0 +1,105 @@
+"""BTF005 — workload/chaos determinism: no unseeded randomness, no
+wall-clock reads.
+
+Past incident class: the workload subsystem's whole contract (PR 10) is
+byte-identical traces — ``sample(n, seed)`` / ``times(n, seed)`` are
+per-request-substreamed so replay, the mixed bench, and the chaos soak
+reproduce exactly. One bare ``random.random()`` (module-global PRNG,
+process-seeded) or ``time.time()`` (wall clock) in that path silently
+breaks replay while every test still passes on its own machine. The
+chaos plan carries the same contract (same plan + seed + call sequence
+=> identical injections, PR 8).
+
+Flags, in the trace-feeding scope (workload/, fleet/chaos.py, and the
+loadgen/replay tooling):
+
+* module-global PRNG draws: ``random.<fn>()`` for any fn except the
+  ``Random``/``SystemRandom`` constructors; ``np.random.<fn>()`` except
+  the seedable constructor forms;
+* unseeded constructors: ``random.Random()`` / ``np.random.default_rng()``
+  with no arguments;
+* wall-clock reads: ``time.time()`` (``time.monotonic`` /
+  ``perf_counter`` measure elapsed time and stay legal — open-loop
+  pacing needs them);
+* entropy sources: ``os.urandom``, ``uuid.uuid4``, ``secrets.*``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import FileContext, Finding, Rule, dotted_name, register
+
+_SEEDED_CONSTRUCTORS = {"Random", "SystemRandom"}
+_NP_SEEDED = {"default_rng", "RandomState", "Generator", "SeedSequence",
+              "PCG64", "Philox"}
+
+
+@register
+class DeterminismRule(Rule):
+    id = "BTF005"
+    name = "workload-determinism"
+    invariant = ("trace-feeding code draws only from seeded generators "
+                 "and never reads the wall clock")
+    scope = ("butterfly_tpu/workload", "butterfly_tpu/fleet/chaos.py",
+             "tools/loadgen.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if not dotted:
+                continue
+            yield from self._check_call(ctx, node, dotted)
+
+    def _check_call(self, ctx: FileContext, node: ast.Call,
+                    dotted: str) -> Iterator[Finding]:
+        parts = dotted.split(".")
+        # random.<fn> — the module-global, process-seeded PRNG. The
+        # constructors are the blessed path (they take the seed).
+        if parts[0] == "random" and len(parts) == 2:
+            fn = parts[1]
+            if fn in _SEEDED_CONSTRUCTORS:
+                if fn == "Random" and not node.args:
+                    yield self.finding(
+                        ctx, node,
+                        "random.Random() without a seed draws from OS "
+                        "entropy — pass the workload/plan seed so the "
+                        "trace replays byte-identically")
+            else:
+                yield self.finding(
+                    ctx, node,
+                    f"module-global random.{fn}() breaks trace "
+                    f"determinism — draw from a seeded random.Random "
+                    f"substream instead")
+            return
+        # np.random.* — same contract for the numpy global state
+        if len(parts) >= 3 and parts[-3] in ("np", "numpy") and \
+                parts[-2] == "random":
+            fn = parts[-1]
+            if fn in _NP_SEEDED:
+                if fn == "default_rng" and not node.args:
+                    yield self.finding(
+                        ctx, node,
+                        "np.random.default_rng() without a seed draws "
+                        "from OS entropy — pass the workload seed")
+            else:
+                yield self.finding(
+                    ctx, node,
+                    f"np.random.{fn}() uses numpy's global PRNG state — "
+                    f"use a seeded default_rng(seed)")
+            return
+        if dotted == "time.time":
+            yield self.finding(
+                ctx, node,
+                "time.time() is a wall-clock read: traces recorded "
+                "against it never replay identically — use "
+                "time.monotonic() for pacing/elapsed measurement")
+            return
+        if dotted == "os.urandom" or dotted == "uuid.uuid4" or \
+                parts[0] == "secrets":
+            yield self.finding(
+                ctx, node,
+                f"{dotted}() is an OS entropy source — trace-feeding "
+                f"code must derive everything from the recorded seed")

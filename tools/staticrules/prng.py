@@ -1,0 +1,163 @@
+"""BTF006 — JAX PRNG key discipline in the sampling paths.
+
+Past incident class: the serving sampler's correctness contract
+(tests/test_spec_sampling.py distribution-parity suite, PR 9) only
+holds if every draw consumes a FRESH key: the engine splits per step
+(``key, sub = jax.random.split(key)``) or derives per scan iteration
+(``fold_in(key, i)``). Passing the same key to two draws makes them
+perfectly correlated (two "independent" samples that always agree);
+building a key from a constant literal inside the serving path makes
+every call draw the identical stream (e.g. a request-independent
+"random" sample).
+
+Two checks, per function, over the engine/sched/serve sampling tier:
+
+* **key reuse** — a key reference consumed by more than one drawing
+  call (``jax.random.uniform/categorical/...`` and the project's own
+  ``sample``/``sample_batched``/``speculative_accept`` wrappers)
+  without being rebound (``split``/``fold_in`` reassignment) between;
+* **constant key** — ``jax.random.PRNGKey(<literal>)`` in serving-path
+  code: a constant key is only legitimate for deliberately-
+  deterministic demo/smoke weight init, which carries an inline
+  suppression explaining exactly that.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from . import (FileContext, Finding, Rule, assigned_handles, call_name,
+               dotted_name, handle_of, register)
+
+#: jax.random drawing functions (consume a key; split/fold_in derive)
+_JAX_CONSUMERS = {
+    "uniform", "normal", "categorical", "gumbel", "bernoulli",
+    "exponential", "randint", "truncated_normal", "choice",
+    "permutation", "laplace", "poisson", "gamma", "beta", "dirichlet",
+}
+
+#: project sampling wrappers: callable name -> key argument position
+PROJECT_CONSUMERS: Dict[str, int] = {
+    "sample": 1,             # sample(logits, key, sp)
+    "sample_batched": 1,     # sample_batched(logits, key, temps, ...)
+    "speculative_accept": 2,  # speculative_accept(logits, drafts, key, ...)
+}
+
+
+def _key_arg(node: ast.Call) -> str:
+    """Handle of the key argument if this call consumes a PRNG key."""
+    func = node.func
+    name = call_name(func)
+    dotted = dotted_name(func)
+    if name in _JAX_CONSUMERS and ("random" in dotted or
+                                   dotted.startswith("jr.")):
+        if node.args:
+            return handle_of(node.args[0])
+        return ""
+    if isinstance(func, ast.Name) and name in PROJECT_CONSUMERS:
+        pos = PROJECT_CONSUMERS[name]
+        if pos < len(node.args):
+            return handle_of(node.args[pos])
+        for kw in node.keywords:
+            if kw.arg == "key":
+                return handle_of(kw.value)
+    return ""
+
+
+@register
+class PrngDisciplineRule(Rule):
+    id = "BTF006"
+    name = "prng-key-discipline"
+    invariant = ("every sampling draw consumes a fresh key; no constant "
+                 "PRNGKey in the serving path")
+    scope = ("butterfly_tpu/engine", "butterfly_tpu/sched",
+             "butterfly_tpu/serve", "butterfly_tpu/fleet/harness.py",
+             "butterfly_tpu/ckpt")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._check_constant_keys(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_reuse(ctx, node)
+
+    def _check_constant_keys(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node.func) == "PRNGKey" and node.args and \
+                    isinstance(node.args[0], ast.Constant):
+                yield self.finding(
+                    ctx, node,
+                    f"constant jax.random.PRNGKey({node.args[0].value!r}) "
+                    f"in the serving path: every call draws the "
+                    f"identical stream — derive the key from the "
+                    f"request/scheduler seed")
+
+    def _check_reuse(self, ctx: FileContext,
+                     fn: ast.FunctionDef) -> Iterator[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, int, str]] = set()
+
+        def block(stmts, consumed: Set[str]) -> Set[str]:
+            for stmt in stmts:
+                consumed = visit_stmt(stmt, consumed)
+            return consumed
+
+        def visit_stmt(stmt, consumed: Set[str]) -> Set[str]:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return consumed
+            if isinstance(stmt, ast.If):
+                c1 = block(stmt.body, set(consumed) | scan(stmt.test,
+                                                           consumed))
+                c2 = block(stmt.orelse, set(consumed))
+                return c1 | c2
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                header = stmt.iter \
+                    if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                    else stmt.test
+                consumed = consumed | scan(header, consumed)
+                consumed -= assigned_handles(stmt)
+                # twice: the same key consumed once per iteration IS
+                # reuse — the second pass sees the first pass's set
+                for _ in range(2):
+                    consumed = block(stmt.body, consumed)
+                return block(stmt.orelse, consumed)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    consumed = consumed | scan(item.context_expr, consumed)
+                return block(stmt.body, consumed)
+            if isinstance(stmt, ast.Try):
+                consumed = block(stmt.body, consumed)
+                merged = set(consumed)
+                for h in stmt.handlers:
+                    merged |= block(h.body, set(consumed))
+                merged = block(stmt.orelse, merged)
+                return block(stmt.finalbody, merged)
+            consumed = consumed | scan(stmt, consumed)
+            return consumed - assigned_handles(stmt)
+
+        def scan(node, consumed: Set[str]) -> Set[str]:
+            """Flag re-consumed keys in this expression/statement;
+            return the keys it newly consumes."""
+            new: Set[str] = set()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                h = _key_arg(sub)
+                if not h:
+                    continue
+                if h in consumed or h in new:
+                    key = (sub.lineno, sub.col_offset, h)
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(self.finding(
+                            ctx, sub,
+                            f"PRNG key {h!r} consumed more than once "
+                            f"without split/fold_in between: the draws "
+                            f"are perfectly correlated — rebind with "
+                            f"key, sub = jax.random.split({h})"))
+                new.add(h)
+            return new
+
+        block(fn.body, set())
+        yield from findings
