@@ -126,6 +126,16 @@ def main() -> int:
         inflight_blocks=1,
         isolated_decode_tok_s_chip=stats["decode_tokens_per_sec_per_chip"],
         **serving_kw)
+    # Write-combined KV window off (ISSUE 12): same operating point with
+    # per-token pool scatters, so the JSON line carries the on/off pair
+    # (`_nowin` suffix, serving_gap style) — the BENCH_r06 batch-128 TPU
+    # comparison is then a --max-batch flag flip, not new plumbing.
+    # Greedy outputs are byte-identical in both modes (parity grid).
+    serving_nowin = run_serving_benchmark(
+        model, params, kv_quant="int8" if on_tpu else "none",
+        kv_write_combine=False,
+        isolated_decode_tok_s_chip=stats["decode_tokens_per_sec_per_chip"],
+        **serving_kw)
     serving = run_serving_benchmark(
         model, params, kv_quant="int8" if on_tpu else "none",
         # serving_gap (serving / isolated tok/s/chip) rides the serving
@@ -136,6 +146,8 @@ def main() -> int:
               "serving_capacity_tokens_per_sec", "serving_gap"):
         if k in serving_sync:
             serving[k + "_sync"] = serving_sync[k]
+        if k in serving_nowin:
+            serving[k + "_nowin"] = serving_nowin[k]
     # Speculation phase (ISSUE 9): spec-on vs spec-off tok/s at the
     # round's operating point plus the speculation instruments —
     # spec_tokens_per_forward (> 1 = drafts landing), the accept rate,

@@ -46,6 +46,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
+# Module-level, deliberately: write_paged_layer runs INSIDE traced code
+# (every decode/prefill/spec dispatch), and a lazy in-function import
+# executes on every trace — the same class of hot-path tax PR 3's
+# _apply_top_k hoist removed. No cycle: models.common imports only
+# core.config and quant.int8.
+from butterfly_tpu.models.common import quantize_kv
 
 
 class PagedKVCache(NamedTuple):
@@ -147,7 +153,6 @@ def write_paged_layer(k_pages: jax.Array, v_pages: jax.Array,
     flat_pages = page_idx.reshape(-1)
     flat_off = offset.reshape(-1)
     if k_scale_pages is not None:
-        from butterfly_tpu.models.common import quantize_kv
         kq, ks = quantize_kv(k)   # codes [B,T,Kv,H], scales [B,T,Kv]
         vq, vs = quantize_kv(v)
         k_pages = k_pages.at[flat_pages, :, flat_off].set(
@@ -191,18 +196,207 @@ def gather_paged_layer_q(pages: jax.Array, scale_pages: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Write-combined decode window (serving hot path)
+#
+# Window-off, every step of a fused decode/spec block scatters its fresh
+# K/V into the FULL [L, P, Kv, page, H] page pool via write_paged_layer —
+# and because the pool rides the block scan's carry, XLA cannot alias the
+# scatter in place: each step pays a pool-sized copy per pool tensor (the
+# same term models/common.py's fused-generate window retired for the
+# contiguous cache; BENCH_r05's 8x serving-vs-engine gap names it for the
+# serving path). With kv_write_combine the pool is READ-ONLY inside the
+# block: fresh K/V stages into a small per-slot window [L, S, Kv, W, H]
+# riding the scan carry, attention reads pool + window, and the window
+# flushes into the pool with ONE scatter per pool tensor per drain.
+#
+# The window stores the pool's EXACT representation (int8 codes + f32
+# scales when the pool is quantized, pool dtype otherwise), and the
+# non-kernel read path INSERTS the window entries into the gathered pool
+# view at their absolute positions rather than concatenating a segment:
+# the attend() call then runs on an element-wise identical operand set to
+# the window-off write-then-gather path, so greedy serving outputs are
+# byte-identical in both modes BY CONSTRUCTION (the parity contract
+# tests/test_sched.py pins). Spec rollback is exact the same way: a
+# rejected draft's K/V sits past win_len, is never attendable (insert
+# positions >= any valid query) and is never flushed — the flushed pool
+# never holds stale speculative state.
+# ---------------------------------------------------------------------------
+
+
+class KVWindow(NamedTuple):
+    """Staged-but-unflushed K/V for every slot, all layers.
+
+    k/v: [L, S, Kv, W, H] in the pool's representation (int8 codes when
+    the pool is quantized, else the pool dtype); k/v_scale [L, S, Kv, W]
+    f32 iff quantized. Entry w of slot s sits at absolute position
+    lengths[s] + w of that slot's sequence, where lengths is the
+    FLUSHED pool length; a separate win_len [S] vector (ridden through
+    the block-scan carry beside this buffer, not stored here — it is
+    shared by all layers) counts the valid entries per slot. Contents
+    past win_len are stale garbage: masking, never zeroing, is the
+    correctness mechanism (the buffer is recycled across blocks without
+    a clear, like every other pool in this codebase)."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def width(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_kv_window(cache: PagedKVCache, width: int) -> KVWindow:
+    """Allocate a window sized to `width` staged tokens per slot, in the
+    pool's representation."""
+    L, _, Kv, _, H = cache.k_pages.shape
+    S = cache.num_slots
+    shape = (L, S, Kv, width, H)
+    if cache.quantized:
+        return KVWindow(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32))
+    return KVWindow(k=jnp.zeros(shape, cache.k_pages.dtype),
+                    v=jnp.zeros(shape, cache.v_pages.dtype))
+
+
+def stage_window_layer(wk, wv, k, v, win_len, wks=None, wvs=None):
+    """Stage one layer's fresh K/V into its window slice.
+
+    wk/wv: [S, Kv, W, H] (this layer's window); k/v: [B, T, Kv, H]
+    floats (B == S); win_len: [S] valid entries BEFORE this call —
+    token t of slot b lands at window index win_len[b] + t, quantized
+    on the way in when scale slices wks/wvs [S, Kv, W] are given (the
+    pool representation, so a later flush copies bytes verbatim and
+    in-window attention dequantizes exactly like the pool read would).
+    Indices never collide with valid entries (writes start AT win_len),
+    so dead slots need no masking: their win_len never advances and
+    their staged bytes stay unattendable garbage. Returns the updated
+    (wk, wv, wks, wvs).
+    """
+    B, T = k.shape[0], k.shape[1]
+    rows = jnp.arange(B)[:, None]                       # [B, 1]
+    idx = win_len[:, None] + jnp.arange(T)[None, :]     # [B, T]
+    if wks is not None:
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        wk = wk.at[rows, :, idx].set(kq, mode="drop")
+        wv = wv.at[rows, :, idx].set(vq, mode="drop")
+        wks = wks.at[rows, :, idx].set(ks, mode="drop")
+        wvs = wvs.at[rows, :, idx].set(vs, mode="drop")
+        return wk, wv, wks, wvs
+    wk = wk.at[rows, :, idx].set(k.astype(wk.dtype), mode="drop")
+    wv = wv.at[rows, :, idx].set(v.astype(wv.dtype), mode="drop")
+    return wk, wv, None, None
+
+
+def insert_window_view(view, wl, base):
+    """Insert a layer's window entries into the gathered float view at
+    their absolute positions: view [B, S_max, Kv, H], wl [S, Kv, W, H],
+    base [S] flushed length per slot. Entries past a slot's valid count
+    land at positions no causal query reaches (>= the query's own
+    position) and positions past S_max drop, so the whole window inserts
+    unconditionally — the result is element-wise identical to the
+    window-off path's written pool view, which is the byte-parity
+    contract."""
+    B = view.shape[0]
+    W = wl.shape[2]
+    pos = base[:, None] + jnp.arange(W)[None, :]        # [B, W]
+    return view.at[jnp.arange(B)[:, None], pos].set(
+        wl.transpose(0, 2, 1, 3), mode="drop")
+
+
+def insert_window_view_q(codes, scales, wl, wsl, base):
+    """Quantized twin: codes [B, Kv, S_max, H] + scales [B, Kv, S_max]
+    gain the window's codes wl [S, Kv, W, H] + scales wsl [S, Kv, W] at
+    absolute positions."""
+    B = codes.shape[0]
+    W = wl.shape[2]
+    rows = jnp.arange(B)[:, None]
+    pos = base[:, None] + jnp.arange(W)[None, :]
+    codes = codes.at[rows, :, pos].set(wl.transpose(0, 2, 1, 3),
+                                       mode="drop")
+    scales = scales.at[rows, :, pos].set(wsl.transpose(0, 2, 1),
+                                         mode="drop")
+    return codes, scales
+
+
+def flush_paged_window(cache: PagedKVCache, window: KVWindow, win_len):
+    """Flush every slot's staged window entries into the page pool: ONE
+    scatter per pool tensor covering ALL layers (the window's write
+    combining — the per-token path pays this scatter, and the carried
+    pool copy behind it, once per token per layer).
+
+    Entries past win_len (dead-step repeats, rejected speculative
+    drafts) route to the null page exactly like write_paged_layer's
+    inactive-slot writes — the flushed pool never holds them, which is
+    what makes spec rollback exact for flushed state. Returns
+    (cache with lengths advanced by win_len, zeroed win_len, flushed
+    token count [scalar]).
+    """
+    L, Pp, Kv, page, H = cache.k_pages.shape
+    S = win_len.shape[0]
+    W = window.width
+    mp = cache.page_table.shape[1]
+    pos = cache.lengths[:, None] + jnp.arange(W)[None, :]     # [S, W]
+    valid = jnp.arange(W)[None, :] < win_len[:, None]
+    page_idx = jnp.take_along_axis(cache.page_table,
+                                   jnp.clip(pos // page, 0, mp - 1), axis=1)
+    page_idx = jnp.where(valid & (pos < mp * page), page_idx, Pp - 1)
+    flat_pages = page_idx.reshape(-1)                          # [S*W]
+    flat_off = (pos % page).reshape(-1)
+    # advanced indices at dims 1 and 3 (slices between) put the index
+    # dim FIRST: values arrive [S*W, L, Kv, H]
+    kv_vals = window.k.transpose(1, 3, 0, 2, 4).reshape(S * W, L, Kv, H)
+    vv_vals = window.v.transpose(1, 3, 0, 2, 4).reshape(S * W, L, Kv, H)
+    k_pages = cache.k_pages.at[:, flat_pages, :, flat_off].set(kv_vals)
+    v_pages = cache.v_pages.at[:, flat_pages, :, flat_off].set(vv_vals)
+    ksp, vsp = cache.k_scale_pages, cache.v_scale_pages
+    if window.quantized:
+        # flat scale dim is kv-major: col = kv*page + offset; adjacent
+        # advanced dims (1, 2) stay in place: values arrive [L, S*W, Kv]
+        cols = jnp.arange(Kv)[None, :] * page + flat_off[:, None]
+        ks_vals = window.k_scale.transpose(0, 1, 3, 2).reshape(L, S * W, Kv)
+        vs_vals = window.v_scale.transpose(0, 1, 3, 2).reshape(L, S * W, Kv)
+        ksp = ksp.at[:, flat_pages[:, None], cols].set(ks_vals)
+        vsp = vsp.at[:, flat_pages[:, None], cols].set(vs_vals)
+    cache = cache._replace(k_pages=k_pages, v_pages=v_pages,
+                           k_scale_pages=ksp, v_scale_pages=vsp,
+                           lengths=cache.lengths + win_len)
+    return cache, jnp.zeros_like(win_len), win_len.sum()
+
+
+# ---------------------------------------------------------------------------
 # Paged forward pass (reference path; Pallas decode kernel lives in ops/)
 # ---------------------------------------------------------------------------
 
 def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
                      positions, mask, cos, sin, active, use_kernel: bool,
-                     fresh: bool, ksp=None, vsp=None):
+                     fresh: bool, ksp=None, vsp=None, win=None):
     """One transformer layer against one layer's page pool slice.
 
-    Shared by paged_forward's full-stack scan and the stage-local scan of
-    the pipeline serving path (parallel/pipeline.py) so the two cannot
-    drift. x: [B,T,D]; kp/vp: [P,Kv,page,H]; ksp/vsp: [P,Kv*page] scale
-    slices iff the pool is int8. Returns (x, kp, vp[, ksp, vsp]).
+    Shared by paged_forward's full-stack scan, the stage-local scan of
+    the pipeline serving path (parallel/pipeline.py), and the
+    write-combined window path (paged_forward_window) so the three
+    cannot drift. x: [B,T,D]; kp/vp: [P,Kv,page,H]; ksp/vsp: [P,Kv*page]
+    scale slices iff the pool is int8. Returns (x, kp, vp[, ksp, vsp]).
+
+    win (kv_write_combine): (wk, wv, wks, wvs, win_len) — this layer's
+    window slices [S, Kv, W, H] (+ [S, Kv, W] scales iff quantized) and
+    the per-slot staged count. The pool slice is then READ-ONLY: fresh
+    K/V stages into the window instead of scattering the pool, and
+    attention reads pool + window (kernel: window segment folded into
+    the online softmax; dense: window inserted into the gathered view
+    at absolute positions, element-wise identical to the window-off
+    written view). Returns (x, wk, wv[, wks, wvs]) — the pool rides
+    outside the scan unchanged.
     """
     from butterfly_tpu.models.common import (
         _cast_float, attend, attn_output, ffn_block, pre_norm, qkv_proj)
@@ -215,16 +409,34 @@ def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
 
     h = pre_norm(x, lp["ln1"], cfg)
     q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-    kp, vp, ksp, vsp = write_paged_layer(kp, vp, page_table, k, v, start,
-                                         active, ksp, vsp)
+    if win is not None:
+        wk, wv, wks, wvs, win_len = win
+        base = start - win_len  # flushed pool length per slot
+        wk, wv, wks, wvs = stage_window_layer(wk, wv, k, v, win_len,
+                                              wks, wvs)
+    else:
+        kp, vp, ksp, vsp = write_paged_layer(kp, vp, page_table, k, v,
+                                             start, active, ksp, vsp)
     out = None
     if use_kernel and T == 1:
         from butterfly_tpu.ops.paged_attention import paged_attention_sharded
-        # lengths INCLUDING the token just written (inactive: 0 -> no
-        # pages visited, output discarded)
-        lens = jnp.where(active, positions[:, 0] + 1, 0)
-        out = paged_attention_sharded(q[:, 0], kp, vp, page_table, lens,
-                                      ksp, vsp)
+        if win is not None:
+            # pool-valid lengths are the FLUSHED base; the staged run
+            # (prior entries + the token just staged) rides as a window
+            # segment with its own count
+            lens = jnp.where(active, base, 0)
+            wcnt = jnp.where(active, win_len + T, 0)
+            out = paged_attention_sharded(q[:, 0], kp, vp, page_table,
+                                          lens, ksp, vsp,
+                                          win_k=wk, win_v=wv,
+                                          win_count=wcnt,
+                                          win_k_scale=wks, win_v_scale=wvs)
+        else:
+            # lengths INCLUDING the token just written (inactive: 0 ->
+            # no pages visited, output discarded)
+            lens = jnp.where(active, positions[:, 0] + 1, 0)
+            out = paged_attention_sharded(q[:, 0], kp, vp, page_table,
+                                          lens, ksp, vsp)
         out = out[:, None] if out is not None else None
     elif cfg.attn_impl == "flash" and T > 1 and fresh:
         from butterfly_tpu.ops.flash_attention import flash_attention_sharded
@@ -237,13 +449,23 @@ def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
         if quant:
             ck, k_s = gather_paged_layer_q(kp, ksp, page_table)
             cv, v_s = gather_paged_layer_q(vp, vsp, page_table)
+            if win is not None:
+                ck, k_s = insert_window_view_q(ck, k_s, wk, wks, base)
+                cv, v_s = insert_window_view_q(cv, v_s, wv, wvs, base)
             out = attend(q, ck, cv, mask, cfg, k_s, v_s)
         else:
             ck = gather_paged_layer(kp, page_table)
             cv = gather_paged_layer(vp, page_table)
+            if win is not None:
+                ck = insert_window_view(ck, wk, base)
+                cv = insert_window_view(cv, wv, base)
             out = attend(q, ck, cv, mask, cfg)
     x = x + attn_output(out, lp["attn"], cfg)
     x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+    if win is not None:
+        if quant:
+            return x, wk, wv, wks, wvs
+        return x, wk, wv
     if quant:
         return x, kp, vp, ksp, vsp
     return x, kp, vp
@@ -307,3 +529,64 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     new_len = jnp.where(active, cache.lengths + T, cache.lengths)
     return logits, PagedKVCache(new_pools[0], new_pools[1],
                                 cache.page_table, new_len, *new_pools[2:])
+
+
+def paged_forward_window(params, cfg: ModelConfig, tokens: jax.Array,
+                         cache: PagedKVCache, window: KVWindow, win_len,
+                         active: Optional[jax.Array] = None,
+                         use_kernel: bool = False):
+    """Windowed (kv_write_combine) forward over [B,T] tokens: the pool
+    is READ-ONLY, fresh K/V stages into `window` at per-slot offset
+    win_len, and attention reads pool + window.
+
+    The per-slot true length is cache.lengths (FLUSHED tokens) +
+    win_len (staged), which replaces window-off paged_forward's
+    positions derivation; neither cache.lengths nor win_len advances
+    here — the block scan advances win_len by what it actually keeps
+    (1 per live decode step; the accepted count m per spec round, which
+    is what makes rollback exact: rejected entries stay past win_len,
+    unattendable and never flushed). Returns (logits [B,T,V], updated
+    window).
+
+    The pool is closed over and indexed in-body (lax.dynamic_index) à
+    la models/common._decode_forward — threading the read-only pools
+    through scan xs would materialize a layer-slice copy per step. Only
+    the small window leaves ride the scan as xs/ys.
+    """
+    from butterfly_tpu.models.common import embed_tokens, final_logits, \
+        make_mask
+
+    B, T = tokens.shape
+    quant = cache.quantized
+    if active is None:
+        active = jnp.ones((B,), bool)
+    positions = (cache.lengths + win_len)[:, None] + jnp.arange(T)[None, :]
+    x, cos, sin = embed_tokens(params, cfg, tokens, positions)
+    mask = make_mask(positions, cache.max_seq)
+    mask = mask & active[:, None, None]
+
+    def body(carry, scanned):
+        x, i = carry
+        lp, wk, wv, *wsc = scanned
+        kp = lax.dynamic_index_in_dim(cache.k_pages, i, 0, keepdims=False)
+        vp = lax.dynamic_index_in_dim(cache.v_pages, i, 0, keepdims=False)
+        ksp = vsp = None
+        if quant:
+            ksp = lax.dynamic_index_in_dim(cache.k_scale_pages, i, 0,
+                                           keepdims=False)
+            vsp = lax.dynamic_index_in_dim(cache.v_scale_pages, i, 0,
+                                           keepdims=False)
+        wks, wvs = wsc if wsc else (None, None)
+        out = paged_layer_body(
+            x, lp, kp, vp, cfg=cfg, page_table=cache.page_table,
+            positions=positions, mask=mask, cos=cos, sin=sin,
+            active=active, use_kernel=use_kernel, fresh=False,
+            ksp=ksp, vsp=vsp, win=(wk, wv, wks, wvs, win_len))
+        return (out[0], i + 1), tuple(out[1:])
+
+    xs = (params["layers"], window.k, window.v)
+    if quant:
+        xs = xs + (window.k_scale, window.v_scale)
+    (x, _), new_win = lax.scan(body, (x, 0), xs)
+    logits = final_logits(params, cfg, x)
+    return logits, KVWindow(*new_win)

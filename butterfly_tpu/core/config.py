@@ -216,6 +216,22 @@ class RuntimeConfig:
                                       # cache as int8 codes + per-vector
                                       # scales: half the HBM bytes in the
                                       # bandwidth-bound decode loop
+    kv_write_combine: bool = True     # serving-path write-combined KV
+                                      # decode window: fused decode/spec
+                                      # blocks stage fresh K/V in a small
+                                      # per-slot window riding the scan
+                                      # carry (the page pool is READ-ONLY
+                                      # inside the block) and the window
+                                      # flushes with ONE pool scatter per
+                                      # drain instead of one per token —
+                                      # the serving twin of decode_window
+                                      # below. Greedy outputs are
+                                      # byte-identical either way (the
+                                      # window stores the pool's exact
+                                      # representation); False = the
+                                      # per-token write_paged_layer path.
+                                      # Ignored (per-token writes) under
+                                      # pipeline (stage>1) serving
     decode_window: int = 0            # fused-generate write combining:
                                       # decode this many tokens into a
                                       # small window, flush to the cache

@@ -44,7 +44,8 @@ import numpy as np
 from jax import lax
 
 from butterfly_tpu.cache.paged import (
-    PagedKVCache, init_paged_cache, paged_forward)
+    KVWindow, PagedKVCache, flush_paged_window, init_kv_window,
+    init_paged_cache, paged_forward, paged_forward_window)
 from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
 from butterfly_tpu.engine.sampling import (
     _filter_logits, speculative_accept)
@@ -218,6 +219,25 @@ class ServingEngine:
         self._fwd = fwd
         self._use_kernels = use_kernels
         self._decode_blocks: Dict[int, object] = {}
+        # Write-combined KV decode window (RuntimeConfig.kv_write_combine,
+        # default on): fused decode/spec blocks stage fresh K/V into an
+        # engine-held KVWindow riding the scan carry — the page pool is
+        # READ-ONLY inside the block — and the pool takes ONE scatter
+        # per flush (scheduler drain) instead of one per token per
+        # layer. The window buffer + its per-slot staged count are
+        # DONATED to every windowed dispatch and rebound from its
+        # results, exactly like the cache (BTF002 contract). The
+        # pipeline serving path (stage > 1) threads pools through its
+        # stage-local scans, so it keeps per-token writes.
+        self._window_mode = bool(self.runtime.kv_write_combine) \
+            and stage == 1
+        self._kv_window: Optional[KVWindow] = None
+        self._win_len = None       # [S] staged count; None = seed zeros
+        self._win_dirty = False    # staged entries not yet flushed
+        self._win_hwm = 0          # host upper bound on staged entries
+        self._decode_win_blocks: Dict[int, object] = {}
+        self._spec_win_blocks: Dict[int, object] = {}
+        self._flush = jax.jit(flush_paged_window, donate_argnums=(0, 2))
         # Fused speculative blocks (scheduler speculative mode): one
         # jitted program per round count, like _decode_blocks. The
         # draft source resolves from runtime.draft_model NOW so a typo
@@ -273,6 +293,65 @@ class ServingEngine:
             # gap (docs/decode_profile_r5.md) — count them in the trace
             self.tracer.event(None, "engine.table_sync")
 
+    # -- write-combined KV window (kv_write_combine) ------------------------
+
+    def _ensure_window(self, need: int) -> None:
+        """Make the window able to accept `need` more staged tokens per
+        slot: flush when the worst-case staged count would overflow the
+        capacity, (re)allocate when the capacity itself is short. Sized
+        to inflight_blocks x need so the scheduler's steady-state lazy
+        drain flushes once per tick while `inflight_blocks` dispatched
+        blocks keep staging."""
+        width = self._kv_window.width if self._kv_window is not None else 0
+        if self._win_hwm + need > width:
+            if self._win_dirty:
+                self.flush_kv_window()
+            if width < need:
+                width = max(1, self.runtime.inflight_blocks) * need
+                with self._mesh_ctx():
+                    win = init_kv_window(self.cache, width)
+                if self.mesh is not None:
+                    from butterfly_tpu.parallel.partition import \
+                        shard_kv_window
+                    win = shard_kv_window(win, self.cfg, self.mesh)
+                self._kv_window = win
+                self._win_len = None
+        if self._win_len is None:
+            self._win_len = jax.device_put(
+                np.zeros((self.num_slots,), np.int32),
+                self.cache.lengths.sharding)
+
+    def flush_kv_window(self):
+        """Flush every staged window entry into the page pool: ONE
+        scatter per pool tensor (cache/paged.py flush_paged_window).
+        Dispatched like any block — device order puts it after every
+        staging dispatch and before anything chained later — so the
+        scheduler calls it at its drain points, before page
+        registration/reclaim ever reads pool state. Returns the
+        device-resident flushed-token count (rides the scheduler's next
+        stacked drain fetch), or None if nothing was staged."""
+        if not self._win_dirty:
+            return None
+        with self._mesh_ctx():
+            cache, wlen, flushed = self._flush(self.cache, self._kv_window,
+                                               self._win_len)
+        self.cache, self._win_len = cache, wlen
+        self._win_dirty = False
+        self._win_hwm = 0
+        return flushed
+
+    def drop_kv_window(self) -> None:
+        """Discard staged-but-unflushed window state WITHOUT touching
+        the device (scheduler.abort_all's wedge path: the device may be
+        the thing that is broken). The staged tokens are simply lost —
+        their requests are being cancelled host-side anyway — and the
+        next windowed dispatch reseeds the staged count from zeros, so
+        a later flush can never scatter stale entries into pages that
+        have been reclaimed and re-admitted."""
+        self._win_dirty = False
+        self._win_hwm = 0
+        self._win_len = None
+
     def prefill_slot(self, slot: int, prompt: list[int]) -> jax.Array:
         """Run one request's whole prompt; returns last-token logits [V]."""
         return self.prefill_chunk(slot, prompt, 0)
@@ -322,6 +401,11 @@ class ServingEngine:
             # host mirror is authoritative (host is the only writer):
             # no device gather of the slot's table row needed
             rows[i] = self._host_table[slot]
+        # a prefill writes the pool at each slot's FLUSHED length, so
+        # staged window entries must land first (the scheduler barriers
+        # before admission anyway — this is the engine-level backstop)
+        if self._win_dirty:
+            self.flush_kv_window()
         fresh = all(s == 0 for s in starts)
         prog = self._prefill if fresh else self._prefill_warm
         if self.tracer is not None:
@@ -367,6 +451,10 @@ class ServingEngine:
         batch). `tokens` may be a host array or a previous call's
         device vector.
         """
+        # the single-step path writes the pool per token; flush any
+        # staged window first so lengths/pool state line up
+        if self._win_dirty:
+            self.flush_kv_window()
         self._sync_table()
         with self._mesh_ctx():
             nxt, logits, cache = self._decode(
@@ -384,6 +472,20 @@ class ServingEngine:
                         use_kernel=self._use_kernels),
                 static_argnums=(7, 8), donate_argnums=(2,))
             self._decode_blocks[k] = prog
+        return prog
+
+    def _decode_block_win_prog(self, k: int):
+        """Windowed twin of _decode_block_prog: the cache, the window
+        buffer, and the staged-count vector are all donated — the pool
+        passes through unmodified (aliased), the window carries the
+        staged K/V to the next dispatch or flush."""
+        prog = self._decode_win_blocks.get(k)
+        if prog is None:
+            prog = jax.jit(
+                partial(_decode_scan_win, self.cfg, k,
+                        use_kernel=self._use_kernels),
+                static_argnums=(9, 10), donate_argnums=(2, 3, 4))
+            self._decode_win_blocks[k] = prog
         return prog
 
     def decode_block_async(self, tokens, active: np.ndarray,
@@ -404,8 +506,28 @@ class ServingEngine:
         scheduler's stacked drain, and the final token vector for
         chaining the next dispatch (the same contract
         decode_active_async's return value carries).
+
+        kv_write_combine: the block stages its K/V into the engine-held
+        window (pool read-only inside the scan) and the scheduler's
+        next drain flushes it — one pool scatter per drain instead of
+        k x L per block. Token outputs are byte-identical either way.
         """
         self._sync_table()
+        if self._window_mode:
+            self._ensure_window(k)
+            with self._mesh_ctx():
+                block, final, cache, window, wlen = \
+                    self._decode_block_win_prog(k)(
+                        self.params, jnp.asarray(tokens), self.cache,
+                        self._kv_window, self._win_len,
+                        jnp.asarray(active, bool), jnp.asarray(temps),
+                        jnp.asarray(stops, jnp.int32),
+                        jnp.asarray(budgets, jnp.int32),
+                        self.runtime_top_k, self.runtime_top_p, key)
+            self.cache, self._kv_window, self._win_len = cache, window, wlen
+            self._win_dirty = True
+            self._win_hwm += k
+            return block, final
         with self._mesh_ctx():
             block, final, cache = self._decode_block_prog(k)(
                 self.params, jnp.asarray(tokens), self.cache,
@@ -428,6 +550,8 @@ class ServingEngine:
         REGISTERED pages (content-immutable — a shared full page is
         never rewritten) may be exported, so in-flight decode blocks
         writing other pages cannot race the bytes."""
+        if self._win_dirty:
+            self.flush_kv_window()
         idx = jnp.asarray(pids, jnp.int32)
         with self._mesh_ctx():
             k = np.asarray(self.cache.k_pages[:, idx])
@@ -473,6 +597,21 @@ class ServingEngine:
             self._spec_blocks[rounds] = prog
         return prog
 
+    def _spec_block_win_prog(self, rounds: int):
+        """Windowed twin of _spec_block_prog: donates the history carry
+        (like the plain spec block) plus the cache / window / staged
+        count triple (like the windowed decode block)."""
+        prog = self._spec_win_blocks.get(rounds)
+        if prog is None:
+            rt = self.runtime
+            prog = jax.jit(
+                partial(_spec_scan_win, self.cfg, rounds,
+                        rt.speculative_gamma, rt.speculative_ngram,
+                        self._draft_fn, use_kernel=self._use_kernels),
+                static_argnums=(10, 11), donate_argnums=(1, 3, 4, 5))
+            self._spec_win_blocks[rounds] = prog
+        return prog
+
     def spec_block_async(self, hist, hist_len, active: np.ndarray,
                          temps: np.ndarray, stops: np.ndarray,
                          budgets, spec_mask: np.ndarray, key: jax.Array,
@@ -492,8 +631,32 @@ class ServingEngine:
         remainder. Returns (toks [rounds, S, C], valid [rounds, S, C],
         hist, hist_len, rem), all device-resident — the stacked
         emissions + validity masks for the scheduler's stacked drain,
-        and the carry for chaining the next dispatch."""
+        and the carry for chaining the next dispatch.
+
+        kv_write_combine: verify writes stage into the engine-held
+        window and only win_len advances by the ACCEPTED count per
+        round — rejected drafts' K/V sit past win_len, unattendable,
+        and are never flushed into the pool (exact rollback by
+        construction)."""
         self._sync_table()
+        if self._window_mode:
+            C = self.runtime.speculative_gamma + 1
+            self._ensure_window(rounds * C)
+            with self._mesh_ctx():
+                toks, valid, hist, hist_len, rem, cache, window, wlen = \
+                    self._spec_block_win_prog(rounds)(
+                        self.params, hist,
+                        jnp.asarray(hist_len, jnp.int32), self.cache,
+                        self._kv_window, self._win_len,
+                        jnp.asarray(active, bool), jnp.asarray(temps),
+                        jnp.asarray(stops, jnp.int32),
+                        jnp.asarray(budgets, jnp.int32),
+                        self.runtime_top_k, self.runtime_top_p, key,
+                        jnp.asarray(spec_mask, bool))
+            self.cache, self._kv_window, self._win_len = cache, window, wlen
+            self._win_dirty = True
+            self._win_hwm += rounds * C
+            return toks, valid, hist, hist_len, rem
         with self._mesh_ctx():
             toks, valid, hist, hist_len, rem, cache = \
                 self._spec_block_prog(rounds)(
@@ -600,6 +763,46 @@ def _decode_scan(cfg: ModelConfig, fwd, k: int, params, tokens,
     return block, final, cache
 
 
+def _decode_scan_win(cfg: ModelConfig, k: int, params, tokens,
+                     cache: PagedKVCache, window: KVWindow, win_len,
+                     active, temps, stops, budgets, top_k: int,
+                     top_p: float, key, use_kernel: bool = False):
+    """Write-combined twin of _decode_scan — the liveness/budget/RNG
+    semantics are IDENTICAL (the parity grid pins byte-equality); only
+    the K/V write target differs. The pool is READ-ONLY (closed over by
+    paged_forward_window, returned unmodified for donation aliasing):
+    each step stages its fresh K/V into the window carry at per-slot
+    offset win_len, which advances with the slot's liveness exactly as
+    cache.lengths does window-off. The pool scatter this scan no longer
+    pays per step — and the pool COPY the scatter forced, because XLA
+    cannot alias a scatter into a scan carry — happens once per
+    scheduler drain (engine.flush_kv_window).
+
+    Returns (block [k, S], final [S], cache, window, win_len).
+    """
+    has_stop = stops >= 0
+    live = active & (budgets > 0) \
+        & jnp.where(has_stop, tokens != stops, True)
+
+    def body(carry, i):
+        cur, win, wlen, live, rem = carry
+        logits, win = paged_forward_window(params, cfg, cur[:, None],
+                                           cache, win, wlen, active=live,
+                                           use_kernel=use_kernel)
+        nxt = sample_batched(logits[:, -1, :], jax.random.fold_in(key, i),
+                             temps, top_k, top_p)
+        nxt = jnp.where(live, nxt, cur)
+        wlen = jnp.where(live, wlen + 1, wlen)
+        rem = jnp.where(live, rem - 1, rem)
+        live = live & (rem > 0) & jnp.where(has_stop, nxt != stops, True)
+        return (nxt, win, wlen, live, rem), nxt
+
+    (final, window, win_len, _, _), block = lax.scan(
+        body, (tokens, window, win_len, live, budgets),
+        jnp.arange(k, dtype=jnp.int32))
+    return block, final, cache, window, win_len
+
+
 def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
                draft_fn, params, hist, hist_len, cache: PagedKVCache,
                active, temps, stops, budgets, top_k: int, top_p: float,
@@ -693,3 +896,76 @@ def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
         body, (hist, hist_len, cache, live0, budgets),
         jnp.arange(rounds, dtype=jnp.int32))
     return toks_blk, valid_blk, hist, hist_len, rem, cache
+
+
+def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
+                   draft_fn, params, hist, hist_len, cache: PagedKVCache,
+                   window: KVWindow, win_len, active, temps, stops,
+                   budgets, top_k: int, top_p: float, key, spec_mask,
+                   use_kernel: bool = False):
+    """Write-combined twin of _spec_scan — draft/verify/accept semantics
+    are IDENTICAL (the spec parity grid pins byte-equality); only the
+    K/V write target differs. Each round's verify stages ALL C = gamma+1
+    positions into the window at offset win_len, then win_len advances
+    by only the ACCEPTED count m — the window-side analogue of
+    _spec_scan's cache-length rollback, but stronger: a rejected
+    draft's K/V sits past win_len, no query can ever attend it (insert
+    positions start at the flushed base + win_len >= every valid
+    query's horizon), and the flush never writes it, so the POOL never
+    holds stale speculative state (window-off relies on the
+    write-then-attend rewrite argument for those positions). The next
+    round's C-wide write at the new win_len overwrites the stale run
+    inside the window buffer itself.
+
+    Returns (toks [rounds, S, C], valid [rounds, S, C], hist, hist_len,
+    rem, cache, window, win_len).
+    """
+    S, H = hist.shape
+    C = gamma + 1
+    has_stop = stops >= 0
+    col = jnp.arange(C)[None, :]
+    rows = jnp.arange(S)[:, None]
+    last0 = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    live0 = active & (budgets > 0) \
+        & jnp.where(has_stop, last0 != stops, True)
+
+    def body(carry, i):
+        hist, hlen, win, wlen, live, rem = carry
+        drafts = draft_fn(hist, hlen, gamma, ngram)
+        last = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        toks = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, C]
+        logits, win = paged_forward_window(params, cfg, toks, cache, win,
+                                           wlen, active=live,
+                                           use_kernel=use_kernel)
+        emitted, n_acc = speculative_accept(
+            logits, drafts, jax.random.fold_in(key, i), temps,
+            top_k, top_p, spec_mask)
+        # emitted prefix n_acc+1, clipped at the remaining budget, cut
+        # at the first stop id INCLUSIVE — byte-for-byte _spec_scan's
+        # truncation
+        cand = (col <= n_acc[:, None]) & (col < rem[:, None])
+        stop_at = cand & has_stop[:, None] & (emitted == stops[:, None])
+        prior = jnp.cumsum(stop_at.astype(jnp.int32), axis=1) \
+            - stop_at.astype(jnp.int32)
+        valid = cand & (prior == 0) & live[:, None]
+        m = valid.sum(axis=1).astype(jnp.int32)
+        # keep the old chain token + the accepted drafts staged; the
+        # last emitted token (correction/bonus) is never staged,
+        # decode-style — win_len is the rollback
+        wlen = jnp.where(live, wlen + m, wlen)
+        wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
+        cur = jnp.take_along_axis(hist, wpos, axis=1)
+        hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
+        hlen = jnp.where(live, hlen + m, hlen)
+        rem = jnp.where(live, rem - m, rem)
+        died = (valid & has_stop[:, None]
+                & (emitted == stops[:, None])).any(axis=1)
+        live = live & ~died & (rem > 0)
+        return (hist, hlen, win, wlen, live, rem), (emitted, valid)
+
+    (hist, hist_len, window, win_len, _, rem), (toks_blk, valid_blk) = \
+        lax.scan(body, (hist, hist_len, window, win_len, live0, budgets),
+                 jnp.arange(rounds, dtype=jnp.int32))
+    return toks_blk, valid_blk, hist, hist_len, rem, cache, window, win_len

@@ -28,6 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 names it TPUCompilerParams; alias so both resolve (the
+# interpret-mode CPU tests otherwise die before interpretation starts)
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 

@@ -37,18 +37,53 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 names it TPUCompilerParams; alias so both resolve (the
+# interpret-mode CPU tests otherwise die before interpretation starts)
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 
-def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
-                  page: int, kv_heads: int, quant: bool):
+def _block_update(s, mask, vf, m_ref, l_ref, acc_ref, vs_row):
+    """One online-softmax accumulation step shared by the page blocks
+    and the window segment: s [Nq, C] masked scores, vf [C, H] values,
+    vs_row optional [1, C] V scales folded into the probs."""
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)       # [Nq, C]
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    if vs_row is not None:
+        p = p * vs_row                                 # V scale into probs
+    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+        p, vf, preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+
+
+def _paged_kernel(table_ref, len_ref, *rest, page: int, kv_heads: int,
+                  quant: bool, window: int):
+    """window > 0: one extra trailing grid step attends the slot's
+    write-combined window segment [Kv, W, H] — staged-but-unflushed
+    K/V at absolute positions length..length+win_count-1 — folded into
+    the same online-softmax recurrence as the page blocks (the
+    kv_write_combine serving path; cache/paged.py window docs)."""
+    if window:
+        wc_ref, *rest = rest
+    q_ref, k_ref, v_ref, *rest = rest
+    ks_ref = vs_ref = wk_ref = wv_ref = wks_ref = wvs_ref = None
     if quant:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
-    else:
-        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref, vs_ref, *rest = rest
+    if window:
+        wk_ref, wv_ref, *rest = rest
+        if quant:
+            wks_ref, wvs_ref, *rest = rest
+    o_ref, m_ref, l_ref, acc_ref = rest
     slot = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
+    npages = nj - 1 if window else nj
     length = len_ref[slot]
 
     @pl.when(j == 0)
@@ -57,7 +92,7 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * page < length)
+    @pl.when((j < npages) & (j * page < length))
     def _compute():
         # Mosaic-friendly GQA: ONE 2D matmul against the flattened
         # [Kv*page, H] block, with cross-group scores masked off. The
@@ -87,18 +122,33 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, *rest,
         group_ok = col_kv == rows // G                 # head n <-> kv n//G
         pos = j * page + col_p
         mask = group_ok & (pos < length)
-        s = jnp.where(mask, s, NEG_INF)
+        _block_update(s, mask, vf, m_ref, l_ref, acc_ref,
+                      vs_ref[0] if quant else None)
 
-        m_prev, l_prev = m_ref[:], l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # [Nq, Kv*page]
-        corr = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        if quant:
-            p = p * vs_ref[0]                          # V scale into probs
-        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-            p, vf, preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
+    if window:
+        @pl.when(j == nj - 1)
+        def _window():
+            # the window segment is one more "page" of width W at
+            # positions >= length, masked by the slot's staged count —
+            # identical recurrence, kv-major flat columns c = kv*W + w
+            q = q_ref[0].astype(jnp.float32)
+            kf = wk_ref[0].astype(jnp.float32).reshape(kv_heads * window, -1)
+            vf = wv_ref[0].astype(jnp.float32).reshape(kv_heads * window, -1)
+            Nq, H = q.shape
+            G = Nq // kv_heads
+            scale = jax.lax.rsqrt(jnp.asarray(H, jnp.float32))
+            s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32)
+            if quant:
+                s = s * wks_ref[0]
+            s = s * scale
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (Nq, kv_heads * window), 1)
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (Nq, kv_heads * window), 0)
+            col_kv, col_w = cols // window, cols % window
+            mask = (col_kv == rows // G) & (col_w < wc_ref[slot])
+            _block_update(s, mask, vf, m_ref, l_ref, acc_ref,
+                          wvs_ref[0] if quant else None)
 
     @pl.when(j == nj - 1)
     def _finalize():
@@ -110,7 +160,12 @@ def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
                             v_pages: jax.Array, page_table: jax.Array,
                             lengths: jax.Array,
                             k_scale_pages: jax.Array = None,
-                            v_scale_pages: jax.Array = None) -> jax.Array:
+                            v_scale_pages: jax.Array = None,
+                            win_k: jax.Array = None,
+                            win_v: jax.Array = None,
+                            win_count: jax.Array = None,
+                            win_k_scale: jax.Array = None,
+                            win_v_scale: jax.Array = None) -> jax.Array:
     """Mesh-aware paged attention for meshed serving (SURVEY.md §7 stage 6).
 
     shard_map over the axes the paged partitioner uses
@@ -125,6 +180,11 @@ def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
     axis can shard the operands — the caller must use the gather path
     (see flash_attention_sharded for the opaque-custom-call rationale);
     with no mesh at all this is exactly `paged_attention`.
+
+    win_k/win_v [S, Kv, W, H] (+ win_k/v_scale [S, Kv, W] iff quant) +
+    win_count [S]: the write-combined window segment (kv_write_combine)
+    — slots shard over `data` with q/table/lengths, kv-heads over
+    `tensor` with the pools.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -138,15 +198,37 @@ def paged_attention_sharded(q: jax.Array, k_pages: jax.Array,
         if live_auto_mesh():
             return None
         return paged_attention(q, k_pages, v_pages, page_table, lengths,
-                               k_scale_pages, v_scale_pages)
+                               k_scale_pages, v_scale_pages,
+                               win_k=win_k, win_v=win_v,
+                               win_count=win_count,
+                               win_k_scale=win_k_scale,
+                               win_v_scale=win_v_scale)
     kv_spec = P(None, t, None, None)
     in_specs = [P(d, t, None), kv_spec, kv_spec, P(d, None), P(d)]
     args = [q, k_pages, v_pages, page_table, lengths]
     if k_scale_pages is not None:
         in_specs += [P(None, t), P(None, t)]
         args += [k_scale_pages, v_scale_pages]
+    if win_k is not None:
+        win_spec = P(d, t, None, None)
+        in_specs += [win_spec, win_spec, P(d)]
+        args += [win_k, win_v, win_count]
+        if win_k_scale is not None:
+            in_specs += [P(d, t, None), P(d, t, None)]
+            args += [win_k_scale, win_v_scale]
+
+        def _kernel(*a):
+            pos = a[:5] if k_scale_pages is None else a[:7]
+            rest = a[len(pos):]
+            kw = dict(win_k=rest[0], win_v=rest[1], win_count=rest[2])
+            if len(rest) > 3:
+                kw.update(win_k_scale=rest[3], win_v_scale=rest[4])
+            return paged_attention(*pos, **kw)
+        target = _kernel
+    else:
+        target = paged_attention
     fn = jax.shard_map(
-        paged_attention,
+        target,
         in_specs=tuple(in_specs),
         out_specs=P(d, t, None),
         axis_names={a for a in (d, t) if a is not None}, check_vma=False)
@@ -158,6 +240,11 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, lengths: jax.Array,
                     k_scale_pages: jax.Array = None,
                     v_scale_pages: jax.Array = None,
+                    win_k: jax.Array = None,
+                    win_v: jax.Array = None,
+                    win_count: jax.Array = None,
+                    win_k_scale: jax.Array = None,
+                    win_v_scale: jax.Array = None,
                     interpret: bool | None = None) -> jax.Array:
     """Single-token attention over each slot's paged KV.
 
@@ -167,20 +254,46 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     number of cache tokens INCLUDING the just-written current token;
     k/v_scale_pages: [P, Kv*page] f32 per-vector scales iff the pool
     holds int8 codes. Returns [slots, Nq, H].
+
+    Write-combined window (kv_write_combine): win_k/win_v [S, Kv, W, H]
+    hold each slot's staged-but-unflushed K/V (pool representation —
+    int8 codes with win_k/v_scale [S, Kv, W] when the pool is
+    quantized), at absolute positions lengths[s]..lengths[s] +
+    win_count[s] - 1; `lengths` is then the FLUSHED pool length only
+    and win_count INCLUDES the just-staged current token. The segment
+    is one extra grid step folded into the same online-softmax
+    recurrence as the page blocks (its DMA is one [Kv, W, H] block per
+    slot — the staged run never round-trips through the pool).
     """
     S, Nq, H = q.shape
     Pp, Kv, page, H2 = k_pages.shape
     max_pages = page_table.shape[1]
     quant = k_scale_pages is not None
+    window = 0 if win_k is None else win_k.shape[2]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    # scalar-prefetch operands: (table, lengths[, win_count]) — the
+    # index maps see them all; the pool maps clamp j to the page grid
+    # (the trailing window step re-fetches the last page, unused)
+    npre = 3 if window else 2
+
+    def pool_map(s, j, t, ln, *wc):
+        return (t[s, jnp.minimum(j, max_pages - 1)], 0, 0, 0)
+
+    def pool_scale_map(s, j, t, ln, *wc):
+        return (t[s, jnp.minimum(j, max_pages - 1)], 0, 0)
+
+    def slot_map(s, j, t, ln, *wc):
+        return (s, 0, 0)
+
+    def win_map(s, j, t, ln, *wc):
+        return (s, 0, 0, 0)
+
     in_specs = [
-        pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
-        pl.BlockSpec((1, Kv, page, H),
-                     lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
-        pl.BlockSpec((1, Kv, page, H),
-                     lambda s, j, t, ln: (t[s, j], 0, 0, 0)),
+        pl.BlockSpec((1, Nq, H), slot_map),
+        pl.BlockSpec((1, Kv, page, H), pool_map),
+        pl.BlockSpec((1, Kv, page, H), pool_map),
     ]
     args = [q, k_pages, v_pages]
     if quant:
@@ -189,18 +302,29 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         # block of a [P, C] array does neither, but (1, 1, C) of
         # [P, 1, C] matches the array exactly.
         in_specs += [
-            pl.BlockSpec((1, 1, Kv * page),
-                         lambda s, j, t, ln: (t[s, j], 0, 0)),
-            pl.BlockSpec((1, 1, Kv * page),
-                         lambda s, j, t, ln: (t[s, j], 0, 0)),
+            pl.BlockSpec((1, 1, Kv * page), pool_scale_map),
+            pl.BlockSpec((1, 1, Kv * page), pool_scale_map),
         ]
         args += [k_scale_pages.reshape(Pp, 1, Kv * page),
                  v_scale_pages.reshape(Pp, 1, Kv * page)]
+    if window:
+        in_specs += [
+            pl.BlockSpec((1, Kv, window, H), win_map),
+            pl.BlockSpec((1, Kv, window, H), win_map),
+        ]
+        args += [win_k, win_v]
+        if quant:
+            in_specs += [
+                pl.BlockSpec((1, 1, Kv * window), slot_map),
+                pl.BlockSpec((1, 1, Kv * window), slot_map),
+            ]
+            args += [win_k_scale.reshape(S, 1, Kv * window),
+                     win_v_scale.reshape(S, 1, Kv * window)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(S, max_pages),
+        num_scalar_prefetch=npre,
+        grid=(S, max_pages + (1 if window else 0)),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Nq, H), lambda s, j, t, ln: (s, 0, 0)),
+        out_specs=pl.BlockSpec((1, Nq, H), slot_map),
         scratch_shapes=[
             pltpu.VMEM((Nq, 1), jnp.float32),
             pltpu.VMEM((Nq, 1), jnp.float32),
@@ -208,7 +332,10 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         ],
     )
     kernel = functools.partial(_paged_kernel, page=page, kv_heads=Kv,
-                               quant=quant)
+                               quant=quant, window=window)
+    prefetch = [page_table, lengths]
+    if window:
+        prefetch.append(win_count)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -216,4 +343,4 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(page_table, lengths, *args)
+    )(*prefetch, *args)

@@ -182,6 +182,29 @@ def shard_paged_cache(cache, cfg: ModelConfig, mesh: Mesh):
     return jax.device_put(cache, to_shardings(specs, mesh))
 
 
+def kv_window_specs(cfg: ModelConfig, mesh: Mesh, num_slots: int,
+                    quant: bool = False):
+    """Specs for the write-combined KV window (cache/paged.py KVWindow,
+    [L, S, Kv, W, H]): slots over `data` with the block table / q rows,
+    kv-heads over `tensor` with the pools — so staging, the kernel's
+    window segment, and the flush scatter all stay local to the shard
+    that owns the matching pool bytes. L stays replicated (the window
+    only exists on the non-pipeline serving path; stage > 1 falls back
+    to per-token writes)."""
+    from butterfly_tpu.cache.paged import KVWindow
+    dslots = _div(num_slots, mesh, "data")
+    tspec = _div(cfg.num_kv_heads, mesh, "tensor")
+    kv = P(None, dslots, tspec, None, None)
+    sc = P(None, dslots, tspec, None) if quant else None
+    return KVWindow(k=kv, v=kv, k_scale=sc, v_scale=sc)
+
+
+def shard_kv_window(window, cfg: ModelConfig, mesh: Mesh):
+    specs = kv_window_specs(cfg, mesh, window.k.shape[1],
+                            quant=window.quantized)
+    return jax.device_put(window, to_shardings(specs, mesh))
+
+
 def activation_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
     """[B,T,D] activations: batch over data, optionally seq over `seq`."""
     return P(_div_any(mesh, "data"), "seq" if seq_sharded and

@@ -190,6 +190,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "`serve --inflight-blocks`); the serving JSON "
                         "carries device_bubble_p50/p95 so the overlap "
                         "is measurable at this depth")
+    b.add_argument("--max-batch", type=positive_int, default=0,
+                   help="serving slot count for --serving/--mixed "
+                        "(default: --batch) — decouples the serving "
+                        "operating point from the isolated-decode "
+                        "batch, so e.g. the ROADMAP item 1 batch-128 "
+                        "serving run is `--serving --max-batch 128` "
+                        "without re-timing isolated decode at 128")
     b.add_argument("--mixed", action="store_true",
                    help="also run the mixed-workload serving phase "
                         "(ISSUE 10): the canned mixed_chat population "
@@ -556,14 +563,15 @@ def cmd_bench(args) -> int:
                                  prompt_len=args.prompt_len,
                                  max_new=args.max_new, mesh=mesh,
                                  kv_quant=args.kv_quant)
+    serving_batch = args.max_batch or args.batch
     if args.serving:
         # the serving path is single-engine: a mesh-sharded tree would
         # need the serving mesh wiring (ServingEngine(mesh=...)); keep
         # the CLI smoke single-chip like bench.py's driver
         serving = run_serving_benchmark(
-            model, params, n_requests=2 * args.batch,
+            model, params, n_requests=2 * serving_batch,
             prompt_len=args.prompt_len, max_new=args.max_new,
-            max_batch=args.batch, kv_quant=args.kv_quant,
+            max_batch=serving_batch, kv_quant=args.kv_quant,
             inflight_blocks=args.inflight_blocks,
             isolated_decode_tok_s_chip=stats[
                 "decode_tokens_per_sec_per_chip"])
@@ -574,8 +582,8 @@ def cmd_bench(args) -> int:
         # (single-engine, like --serving)
         from butterfly_tpu.obs.benchmark import run_mixed_benchmark
         stats.update(run_mixed_benchmark(
-            model, params, n_requests=2 * args.batch,
-            max_batch=args.batch,
+            model, params, n_requests=2 * serving_batch,
+            max_batch=serving_batch,
             prompt_lo=max(8, args.prompt_len // 4),
             prompt_hi=args.prompt_len,
             max_new_lo=max(4, args.max_new // 4),

@@ -117,6 +117,15 @@ SLOW_TESTS = {
     "test_pipelined_greedy_parity_vs_synchronous",
     "test_pipelined_greedy_parity_fused_k8",
     "test_pipelined_parity_under_page_pressure",
+    # write-combined KV window grids: 8 (resp. 4) scheduler compiles
+    # each (the fast tier still pins the contract directly:
+    # kv_write_combine defaults on so EVERY parity test above decodes
+    # through the window, test_kv_window_off_matches_on pins on/off
+    # byte-equality + the flush instruments, and the flush-before-
+    # reclaim / spec-rejection tests pin the drain semantics)
+    "test_kv_window_greedy_parity_grid",
+    "test_kv_window_seeded_sampling_parity",
+    "test_kv_window_spec_parity_grid",
     # fleet scenarios that compile one-or-more extra engines or spin a
     # multi-replica in-process topology (the fast tier keeps the pure-
     # host fleet units: allocator transfer surface, load_score page

@@ -40,3 +40,36 @@ class Engine:
             nxt, logits, cache = self._decode(params, toks, cache)
             out.append(nxt)
         return out, cache
+
+
+def _step_win(params, toks, cache, window, wlen):
+    return toks, toks, cache, window, wlen
+
+
+class WindowEngine:
+    """Blessed window-carry pattern (ISSUE 12): every donated carry —
+    cache, staged-window buffer, staged count — is rebound from the
+    result before any later read (serving.py decode_block_async)."""
+
+    def __init__(self):
+        self._win_progs = {}
+        self._flush = jax.jit(_step_win, donate_argnums=(2, 4))
+
+    def _win_prog(self, k):
+        prog = self._win_progs.get(k)
+        if prog is None:
+            prog = jax.jit(_step_win, donate_argnums=(2, 3, 4))
+            self._win_progs[k] = prog
+        return prog
+
+    def windowed_dispatch(self, params, toks, k):
+        blk, fin, cache, window, wlen = self._win_prog(k)(
+            params, toks, self.cache, self._window, self._wlen)
+        self.cache, self._window, self._wlen = cache, window, wlen
+        return blk, self._window.width
+
+    def flush(self, params, toks):
+        blk, fin, cache, window, wlen = self._flush(
+            params, toks, self.cache, self._window, self._wlen)
+        self.cache, self._wlen = cache, wlen
+        return self._window         # NOT donated by the flush: clean read

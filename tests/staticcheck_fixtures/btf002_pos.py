@@ -1,9 +1,12 @@
 """BTF002 positive fixture: reads of donated references after dispatch.
 
-Expected findings: 3 —
+Expected findings: 4 —
 * a read of the donated cache in the statement after the dispatch,
 * the same handle re-passed on the next loop iteration without rebind,
-* a read of a tree donated to a locally-built donating jit.
+* a read of a tree donated to a locally-built donating jit,
+* a window-carry dispatch (ISSUE 12: factory program donating the
+  cache AND the staged-window buffers) that rebinds the cache but
+  reads the donated window attribute afterwards.
 """
 import jax
 
@@ -34,3 +37,29 @@ def local_jit(tree):
     cast = jax.jit(lambda p: p, donate_argnums=(0,))
     out = cast(tree)
     return out, tree                                  # finding 3
+
+
+def _step_win(params, toks, cache, window, wlen):
+    return toks, toks, cache, window, wlen
+
+
+class WindowEngine:
+    """The write-combined-window carry: one program donates the cache
+    AND the staged-window buffer + count (serving.py's
+    _decode_block_win_prog shape)."""
+
+    def __init__(self):
+        self._win_progs = {}
+
+    def _win_prog(self, k):
+        prog = self._win_progs.get(k)
+        if prog is None:
+            prog = jax.jit(_step_win, donate_argnums=(2, 3, 4))
+            self._win_progs[k] = prog
+        return prog
+
+    def stale_window_read(self, params, toks, k):
+        blk, fin, cache, window, wlen = self._win_prog(k)(
+            params, toks, self.cache, self._window, self._wlen)
+        self.cache = cache          # cache rebound...
+        return blk, self._window    # finding 4: window NOT rebound

@@ -115,6 +115,100 @@ def test_paged_attention_int8_parity():
                                rtol=2e-5, atol=2e-5)
 
 
+def _insert_window(view, win, lengths, counts):
+    """Dense reference insert: window entry w of slot s lands at
+    absolute position lengths[s] + w, entries past counts[s] dropped.
+    view [S, MP*page, Kv, H]; win [S, Kv, W, H]."""
+    out = np.asarray(view).copy()
+    W = win.shape[2]
+    for s in range(view.shape[0]):
+        for w in range(min(int(counts[s]), W)):
+            out[s, int(lengths[s]) + w] = np.asarray(win[s, :, w])
+    return jnp.asarray(out)
+
+
+def test_paged_attention_window_segment_parity():
+    """The write-combined window segment (kv_write_combine): staged
+    K/V [S, Kv, W, H] at absolute positions lengths..lengths+count-1
+    folds into the online softmax exactly like an inserted dense view;
+    entries past win_count must be invisible (they are recycled-buffer
+    garbage by contract)."""
+    S, Nq, Kv, H, page, P, MP, W = 3, 8, 2, 16, 4, 10, 4, 5
+    ks = jax.random.split(jax.random.PRNGKey(7), 5)
+    q = jax.random.normal(ks[0], (S, Nq, H))
+    k_pages = jax.random.normal(ks[1], (P, Kv, page, H))
+    v_pages = jax.random.normal(ks[2], (P, Kv, page, H))
+    win_k = jax.random.normal(ks[3], (S, Kv, W, H))
+    win_v = jax.random.normal(ks[4], (S, Kv, W, H))
+    table = jnp.asarray([[0, 2, 9, 9], [3, 1, 4, 9], [5, 6, 7, 8]],
+                        jnp.int32)
+    lengths = jnp.asarray([6, 3, 9], jnp.int32)   # FLUSHED pool lengths
+    counts = jnp.asarray([3, 5, 0], jnp.int32)    # staged entries/slot
+    out = paged_attention(q, k_pages, v_pages, table, lengths,
+                          win_k=win_k, win_v=win_v, win_count=counts)
+
+    kk = _insert_window(_gather_pool(k_pages, table), win_k, lengths,
+                        counts)
+    vv = _insert_window(_gather_pool(v_pages, table), win_v, lengths,
+                        counts)
+    total = (lengths + counts)[:, None, None]
+    mask = jnp.arange(MP * page)[None, None, :] < total
+    ref = attend(q[:, None], kk, vv, mask, None)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # garbage past win_count must not leak into the output
+    poisoned = win_k.at[:, :, 4:].set(1e3)
+    out2 = paged_attention(q, k_pages, v_pages, table, lengths,
+                           win_k=poisoned, win_v=win_v,
+                           win_count=jnp.minimum(counts, 4))
+    ref2 = paged_attention(q, k_pages, v_pages, table, lengths,
+                           win_k=win_k, win_v=win_v,
+                           win_count=jnp.minimum(counts, 4))
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref2))
+
+
+def test_paged_attention_window_segment_int8_parity():
+    """Quantized window segment: codes + [S, Kv, W] scales dequantize
+    inside the kernel's window step exactly like the pool blocks."""
+    from butterfly_tpu.models.common import quantize_kv
+
+    S, Nq, Kv, H, page, P, MP, W = 3, 8, 2, 16, 4, 10, 4, 4
+    ks = jax.random.split(jax.random.PRNGKey(17), 5)
+    q = jax.random.normal(ks[0], (S, Nq, H))
+    kf = jax.random.normal(ks[1], (P, Kv, page, H))
+    vf = jax.random.normal(ks[2], (P, Kv, page, H))
+    wkf = jax.random.normal(ks[3], (S, Kv, W, H))
+    wvf = jax.random.normal(ks[4], (S, Kv, W, H))
+    kq, ksc = quantize_kv(kf)
+    vq, vsc = quantize_kv(vf)
+    wkq, wks = quantize_kv(wkf)   # codes [S,Kv,W,H], scales [S,Kv,W]
+    wvq, wvs = quantize_kv(wvf)
+    table = jnp.asarray([[0, 2, 9, 9], [3, 1, 4, 9], [5, 6, 7, 8]],
+                        jnp.int32)
+    lengths = jnp.asarray([6, 3, 9], jnp.int32)
+    counts = jnp.asarray([2, 4, 0], jnp.int32)
+    out = paged_attention(q, kq, vq, table, lengths,
+                          ksc.reshape(P, Kv * page),
+                          vsc.reshape(P, Kv * page),
+                          win_k=wkq, win_v=wvq, win_count=counts,
+                          win_k_scale=wks, win_v_scale=wvs)
+
+    kk = _insert_window(_gather_pool(kq.astype(jnp.float32)
+                                     * ksc[..., None], table),
+                        wkq.astype(jnp.float32) * wks[..., None],
+                        lengths, counts)
+    vv = _insert_window(_gather_pool(vq.astype(jnp.float32)
+                                     * vsc[..., None], table),
+                        wvq.astype(jnp.float32) * wvs[..., None],
+                        lengths, counts)
+    total = (lengths + counts)[:, None, None]
+    mask = jnp.arange(MP * page)[None, None, :] < total
+    ref = attend(q[:, None], kk, vv, mask, None)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_paged_attention_zero_length_slot():
     """length 0 (inactive slot) visits no pages and returns zeros."""
     S, Nq, Kv, H, page, P = 2, 4, 4, 8, 4, 4

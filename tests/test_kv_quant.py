@@ -264,3 +264,34 @@ def test_serving_int8_under_stage_mesh_matches_unmeshed():
     mesh = make_mesh(MeshConfig(stage=2), jax.devices()[:2])
     got = _run_sched(params, _SERVE_RT, mesh=mesh, max_new=6)
     assert got == ref
+
+
+def test_int8_quantize_on_flush_parity():
+    """Write-combined KV window over the int8 pool (ISSUE 12): the
+    window stages the pool's EXACT representation (codes + scales via
+    the same quantize_kv the per-token write path uses), so greedy
+    serving is byte-identical window on/off — and the flushed pool
+    bytes themselves match the per-token path's, codes AND scales, on
+    every real page (the null overflow page is scratch in both modes).
+    """
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    def run(rt):
+        params = Model(CFG).init(jax.random.PRNGKey(2))
+        sched = Scheduler(ServingEngine(Model(CFG), params, rt))
+        reqs = [sched.submit(p, max_new_tokens=8)
+                for p in [[5, 7, 11, 2], [3, 1]]]
+        sched.run_until_done()
+        return [r.output for r in reqs], sched.engine.cache
+
+    on_toks, on_cache = run(_SERVE_RT)
+    off_toks, off_cache = run(_SERVE_RT.replace(kv_write_combine=False))
+    assert on_toks == off_toks
+    null = on_cache.num_pages - 1  # overflow page: dead-write scratch
+    for a, b in ((on_cache.k_pages, off_cache.k_pages),
+                 (on_cache.v_pages, off_cache.v_pages),
+                 (on_cache.k_scale_pages, off_cache.k_scale_pages),
+                 (on_cache.v_scale_pages, off_cache.v_scale_pages)):
+        np.testing.assert_array_equal(np.asarray(a[:, :null]),
+                                      np.asarray(b[:, :null]))

@@ -511,8 +511,14 @@ def test_fused_block_eos_mid_block():
     # The block has run past the EOS position on device. Written K/V:
     # 3 prompt tokens + generated tokens 1 and 2; the EOS (3rd) is
     # sampled but never consumed, and every later step was masked dead
-    # — lengths froze, writes landed on the null page.
-    assert int(np.asarray(sched.engine.cache.lengths)[slot]) == 3 + 2
+    # — the device length count froze, writes landed on the null page
+    # (window-off) or stayed unstaged (kv_write_combine: the flushed
+    # pool length plus the staged window count is the same total).
+    staged = 0
+    if sched.engine._win_len is not None:
+        staged = int(np.asarray(sched.engine._win_len)[slot])
+    total = int(np.asarray(sched.engine.cache.lengths)[slot]) + staged
+    assert total == 3 + 2
     sched.run_until_done()
     assert req.output == base.output[:3]
     assert req.state == "finished"
@@ -991,3 +997,168 @@ def test_submit_rejects_unknown_priority():
     sched, _ = make_sched()
     with pytest.raises(ValueError, match="priority"):
         sched.submit([1], max_new_tokens=2, priority="best-effort")
+
+
+# -- write-combined KV decode window (ISSUE 12) -----------------------------
+
+
+def test_kv_window_off_matches_on():
+    """Core on/off contract: kv_write_combine stages K/V in the window
+    and flushes once per drain, yet greedy outputs are byte-identical
+    to the per-token write path — and only the window mode populates
+    the flush instruments."""
+    prompts = [[5, 7, 11], [3, 1]]
+    on, _ = make_sched(max_batch=2)  # kv_write_combine defaults on
+    off, _ = make_sched(max_batch=2, kv_write_combine=False)
+    a = [on.submit(p, max_new_tokens=10) for p in prompts]
+    b = [off.submit(p, max_new_tokens=10) for p in prompts]
+    on.run_until_done()
+    off.run_until_done()
+    assert [r.output for r in a] == [r.output for r in b]
+    m_on, m_off = on.metrics(), off.metrics()
+    assert m_on["kv_window_tokens_flushed_total"] > 0
+    assert "kv_flush_p50" in m_on and "kv_flush_p95" in m_on
+    assert "kv_window_tokens_flushed_total" not in m_off
+    # every generated-and-consumed token was flushed exactly once; the
+    # final sampled token of each request is never written (decode
+    # contract), so flushed == generated - one per finished request
+    assert m_on["kv_window_tokens_flushed_total"] == \
+        m_on["tokens_generated_total"] - len(prompts)
+
+
+def test_kv_window_greedy_parity_grid():
+    """Acceptance grid: window on/off x decode_steps_per_tick 1/8 x
+    dispatch-ahead depth 1/2, all byte-identical to the contiguous
+    reference."""
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2]]
+    ref, _ = make_sched(max_batch=4)
+    want = [ref.submit(p, max_new_tokens=12) for p in prompts]
+    ref.run_until_done()
+    for wc in (True, False):
+        for k in (1, 8):
+            for depth in (1, 2):
+                sched, _ = make_sched(max_batch=4, kv_write_combine=wc,
+                                      decode_steps_per_tick=k,
+                                      inflight_blocks=depth)
+                got = [sched.submit(p, max_new_tokens=12) for p in prompts]
+                sched.run_until_done()
+                assert [r.output for r in got] == \
+                    [r.output for r in want], (wc, k, depth)
+
+
+def test_kv_window_seeded_sampling_parity():
+    """temperature > 0 with a pinned scheduler seed: the windowed path
+    derives the same per-step fold_in keys from the same block
+    dispatches, so sampled streams match window-off exactly."""
+    for k in (1, 8):
+        outs = {}
+        for wc in (True, False):
+            sched, _ = make_sched(max_batch=2, seed=7, kv_write_combine=wc,
+                                  decode_steps_per_tick=k)
+            r1 = sched.submit([5, 7, 11], max_new_tokens=10,
+                              temperature=0.8)
+            r2 = sched.submit([3, 1], max_new_tokens=10, temperature=1.3)
+            sched.run_until_done()
+            outs[wc] = (r1.output, r2.output)
+        assert outs[True] == outs[False], k
+
+
+def test_kv_window_spec_parity_grid():
+    """Speculative serving window on/off x rounds-per-tick 1/8: the
+    window's accepted-count advance is the exact analogue of the spec
+    scan's cache-length rollback, byte-identical greedy output."""
+    prompts = [[5, 7, 11], [3, 1]]
+    ref, _ = make_sched(max_batch=2)
+    want = [ref.submit(p, max_new_tokens=12) for p in prompts]
+    ref.run_until_done()
+    for wc in (True, False):
+        for k in (1, 8):
+            sched, _ = make_sched(max_batch=2, speculative_gamma=3,
+                                  kv_write_combine=wc,
+                                  decode_steps_per_tick=k)
+            got = [sched.submit(p, max_new_tokens=12) for p in prompts]
+            sched.run_until_done()
+            assert [r.output for r in got] == \
+                [r.output for r in want], (wc, k)
+
+
+def test_kv_window_preempt_mid_block_flush_before_reclaim():
+    """Preemption under page pressure with staged window entries: the
+    drain barrier's flush lands every staged K/V byte in the pool
+    BEFORE any victim page is reclaimed, so recompute-preempted and
+    surviving requests both stay byte-correct and the flush counter
+    advances."""
+    sched, params = make_sched(max_batch=2, max_seq=32, page=4,
+                               num_pages=6, inflight_blocks=2,
+                               decode_steps_per_tick=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=10)
+    r2 = sched.submit([3, 1], max_new_tokens=10)
+    sched.run_until_done(max_ticks=500)
+    m = sched.metrics()
+    assert m["preemptions_total"] > 0
+    assert m["kv_window_tokens_flushed_total"] > 0
+    assert not sched.engine._win_dirty
+    assert r1.output == ref_tokens(params, [5, 7, 11], 10)
+    assert r2.output == ref_tokens(params, [3, 1], 10)
+
+
+def test_kv_window_cancel_mid_block_flush_before_reclaim():
+    """cancel() with blocks in flight and staged-but-unflushed window
+    entries: the drain barrier flushes before the cancelled request's
+    pages are reclaimed, and a follow-up request that reuses the slot
+    and pages still matches its reference (a dropped or stale flush
+    would scatter old K/V into the readmitted pages)."""
+    sched, params = make_sched(max_batch=2, inflight_blocks=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=30)
+    r2 = sched.submit([3, 1], max_new_tokens=8)
+    sched.tick()
+    sched.tick()
+    assert sched._inflight  # blocks (and staged K/V) in flight
+    sched.cancel(r1)
+    assert r1.state == "cancelled" and r1.slot is None
+    assert not sched.engine._win_dirty  # the barrier flushed, not leaked
+    r3 = sched.submit([2, 4, 6], max_new_tokens=8)
+    sched.run_until_done()
+    assert r2.output == ref_tokens(params, [3, 1], 8)
+    assert r3.output == ref_tokens(params, [2, 4, 6], 8)
+    assert sched.alloc.free_pages == sched.alloc.num_pages
+
+
+def test_kv_window_spec_rejection_never_flushed():
+    """The rollback-by-construction contract: a rejected draft's K/V
+    sits past win_len and is NEVER flushed, so pool bytes beyond each
+    slot's flushed length stay pristine (init zeros). Window-off writes
+    all gamma+1 verify positions into the pool and relies on the
+    rollback + write-then-attend rewrite argument — its pool DOES carry
+    stale bytes past the written length, which is the discriminator
+    this test pins."""
+    import jax.numpy as jnp
+
+    def stale_bytes(sched, slot):
+        """Max |pool byte| past the slot's flushed length."""
+        cache = sched.engine.cache
+        kp = np.asarray(cache.k_pages)          # [L, P, Kv, page, H]
+        page = kp.shape[3]
+        length = int(np.asarray(cache.lengths)[slot])
+        pids = sched.alloc.pages_of(slot)
+        worst = 0.0
+        for j, pid in enumerate(pids):
+            lo = max(0, length - j * page)      # valid offsets in page j
+            if lo < page:
+                worst = max(worst,
+                            float(np.abs(kp[:, pid, :, lo:, :]).max()))
+        return worst
+
+    runs = {}
+    for wc in (True, False):
+        sched, _ = make_sched(max_batch=1, max_seq=64,
+                              speculative_gamma=3, kv_write_combine=wc)
+        req = sched.submit([5, 7, 5, 7, 5], max_new_tokens=40)
+        for _ in range(4):
+            sched.tick()
+        sched._drain_inflight()  # flush + surface everything dispatched
+        assert not req.done      # still mid-generation: pages live
+        assert sched.metrics()["spec_forwards_total"] > 0
+        runs[wc] = stale_bytes(sched, req.slot)
+    assert runs[True] == 0.0    # windowed pool: no stale spec bytes
+    assert runs[False] > 0.0    # per-token path: rollback leaves them

@@ -156,6 +156,25 @@ MUTANTS = [
      "vals[slot] = len(req.all_tokens) - 1",
      "vals[slot] = len(req.all_tokens)",
      ["tests/test_sched.py"], {}),
+    # write-combined KV window (ISSUE 12): drop the flush's K-pool
+    # scatter — staged K bytes never land, so after a drain the pool
+    # serves zeros for flushed positions. Killed by the int8
+    # quantize-on-flush parity test (token parity AND a byte-level
+    # pool compare vs the per-token path — the float smoke model's
+    # greedy argmax can shrug off zeroed K, the int8 path cannot).
+    ("butterfly_tpu/cache/paged.py",
+     "k_pages = cache.k_pages.at[:, flat_pages, :, flat_off].set(kv_vals)",
+     "k_pages = cache.k_pages",
+     ["tests/test_kv_quant.py", "tests/test_sched.py"], {}),
+    # write-combined KV window, spec: flush without rollback truncation
+    # — win_len advances by the full gamma+1 verify width instead of
+    # the ACCEPTED count, so rejected drafts become attendable/flushable
+    # and the window desynchronizes from the token history (killed by
+    # the spec parity grid + the rejection-never-flushed pool probe)
+    ("butterfly_tpu/engine/serving.py",
+     "wlen = jnp.where(live, wlen + m, wlen)",
+     "wlen = jnp.where(live, wlen + C, wlen)",
+     ["tests/test_sched.py"], {}),
     # workload generator: the Poisson arrival process ignores its rate
     # (every open-loop bench/sweep would silently offer ~1 req/s
     # regardless of the requested load) — the arrival-statistics test
