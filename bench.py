@@ -60,7 +60,8 @@ def main() -> int:
                                              run_fleet_benchmark,
                                              run_mixed_benchmark,
                                              run_serving_benchmark,
-                                             run_spec_benchmark)
+                                             run_spec_benchmark,
+                                             run_warm_prefill_benchmark)
     from butterfly_tpu.quant.int8 import init_params_quantized
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -155,6 +156,19 @@ def main() -> int:
     # pipeline instead of barriering like the old host accept loop).
     # Draft-friendly workload (prompts seeded with the model's own
     # greedy continuation) so prompt lookup has something to mine.
+    # Warm-prefix flash prefill phase (ISSUE 13): long prompts (>= 512)
+    # prefilled in chunks, so every chunk after the first runs the warm
+    # path and admission rounds mix warm continuations with fresh
+    # arrivals. On/off pair at the same operating point rides the JSON
+    # under the `_dense` suffix (the `_nowin` pattern): off = the dense
+    # O(T*S) warm fallback + the gang-freshness split this PR retires.
+    # The prompt >= 512 grid point runs on BOTH platforms; on TPU the
+    # on leg takes the kernel, on CPU (kernels are TPU-only) it
+    # measures the gang-merge half and warm_prefill_kernelized: false
+    # records that honestly.
+    serving.update(run_warm_prefill_benchmark(
+        model, params, kv_quant=kv_quant, prompt_len=640,
+        prefill_chunk=256, n_requests=6, max_batch=4))
     spec_kw = dict(n_requests=serving_kw["n_requests"],
                    prompt_len=serving_kw["prompt_len"],
                    max_new=serving_kw["max_new"],

@@ -46,12 +46,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
-# Module-level, deliberately: write_paged_layer runs INSIDE traced code
-# (every decode/prefill/spec dispatch), and a lazy in-function import
-# executes on every trace — the same class of hot-path tax PR 3's
-# _apply_top_k hoist removed. No cycle: models.common imports only
-# core.config and quant.int8.
-from butterfly_tpu.models.common import quantize_kv
+# Module-level, deliberately: these all run INSIDE traced code (every
+# decode/prefill/spec dispatch), and a lazy in-function import executes
+# on every trace — the same class of hot-path tax PR 3's _apply_top_k
+# hoist removed (ISSUE 13 satellite: the remaining paged_layer_body /
+# paged_forward in-function imports hoisted alongside the new warm-flash
+# call). No cycle: models.common imports core.config, quant.int8, and
+# ops.flash_attention, none of which import this module; the ops kernel
+# wrappers import nothing project-local at module level.
+from butterfly_tpu.models.common import (
+    _cast_float, attend, attn_output, embed_tokens, ffn_block,
+    final_logits, make_mask, pre_norm, qkv_proj, quantize_kv)
+from butterfly_tpu.ops.flash_attention import flash_attention_sharded
+from butterfly_tpu.ops.paged_attention import paged_attention_sharded
 
 
 class PagedKVCache(NamedTuple):
@@ -398,9 +405,6 @@ def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
     written view). Returns (x, wk, wv[, wks, wvs]) — the pool rides
     outside the scan unchanged.
     """
-    from butterfly_tpu.models.common import (
-        _cast_float, attend, attn_output, ffn_block, pre_norm, qkv_proj)
-
     T = x.shape[1]
     quant = ksp is not None
     compute_dtype = jnp.dtype(cfg.dtype)
@@ -419,7 +423,6 @@ def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
                                              start, active, ksp, vsp)
     out = None
     if use_kernel and T == 1:
-        from butterfly_tpu.ops.paged_attention import paged_attention_sharded
         if win is not None:
             # pool-valid lengths are the FLUSHED base; the staged run
             # (prior entries + the token just staged) rides as a window
@@ -439,10 +442,39 @@ def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
                                           lens, ksp, vsp)
         out = out[:, None] if out is not None else None
     elif cfg.attn_impl == "flash" and T > 1 and fresh:
-        from butterfly_tpu.ops.flash_attention import flash_attention_sharded
         # fresh prefill attends over the just-projected bf16 K/V, so the
         # kernel path is identical for int8 pools
         out = flash_attention_sharded(q, k, v, causal=True)
+    elif cfg.attn_impl == "flash" and T > 1 and win is None:
+        # warm chunked prefill (ISSUE 13): the kernel attends the
+        # CACHED prefix — the gathered pool view, count-masked per row
+        # at the chunk's start (so the chunk's own just-written copy,
+        # null-page garbage, and padding rows never contribute) — plus
+        # the fresh chunk as causal blocks, one online-softmax state.
+        # This replaces the dense O(T*S_max) materialized-scores
+        # fallback every warm/chunked/prefix-hit prefill used to pay.
+        # (The windowed verify path keeps the dense insert: staged
+        # window entries are not in the pool.)
+        base = jnp.where(active, start, 0)
+        if quant:
+            ckg, k_sg = gather_paged_layer_q(kp, ksp, page_table)
+            cvg, v_sg = gather_paged_layer_q(vp, vsp, page_table)
+            # mirror the chunk's in-pool representation (the dense path
+            # reads the quantized write back) — operand-parity with the
+            # gather path by construction
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            kf = (kq.astype(jnp.float32) * ksc[..., None]).astype(k.dtype)
+            vf = (vq.astype(jnp.float32) * vsc[..., None]).astype(v.dtype)
+            out = flash_attention_sharded(
+                q, kf, vf, causal=True, prefix_k=ckg, prefix_v=cvg,
+                prefix_len=base, prefix_k_scale=k_sg, prefix_v_scale=v_sg)
+        else:
+            ckg = gather_paged_layer(kp, page_table)
+            cvg = gather_paged_layer(vp, page_table)
+            out = flash_attention_sharded(q, k, v, causal=True,
+                                          prefix_k=ckg, prefix_v=cvg,
+                                          prefix_len=base)
     if out is None:
         # no mesh axis can shard the kernel operands (or kernels off):
         # dense gather attention, which GSPMD partitions itself.
@@ -495,8 +527,6 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     that index — logits come back [B,1,V] (models.common.forward docs:
     the full-T head dominates prefill memory at LLM vocab sizes).
     """
-    from butterfly_tpu.models.common import embed_tokens, final_logits, make_mask
-
     B, T = tokens.shape
     quant = cache.quantized
     if positions is None:
@@ -553,9 +583,6 @@ def paged_forward_window(params, cfg: ModelConfig, tokens: jax.Array,
     through scan xs would materialize a layer-slice copy per step. Only
     the small window leaves ride the scan as xs/ys.
     """
-    from butterfly_tpu.models.common import embed_tokens, final_logits, \
-        make_mask
-
     B, T = tokens.shape
     quant = cache.quantized
     if active is None:

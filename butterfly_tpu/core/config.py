@@ -51,8 +51,10 @@ class ModelConfig:
     param_dtype: str = "float32"      # master param dtype
 
     # attention implementation: "dense" = XLA einsum attend over the cache;
-    # "flash" = Pallas blockwise kernel over the freshly-projected K/V —
-    # ONLY valid for fresh prefills (cache empty, positions 0..T-1); the
+    # "flash" = Pallas blockwise kernel — fresh prefills attend the
+    # freshly-projected K/V, warm multi-token steps (chunk continuations,
+    # prefix-cache resumes) fold the cached context in as a count-masked
+    # prefix segment (ops/flash_attention.py warm-prefix prefill); the
     # engines swap it in for exactly those steps.
     attn_impl: str = "dense"
 
@@ -212,6 +214,22 @@ class RuntimeConfig:
     prefix_caching: bool = False      # content-hash KV page reuse across
                                       # requests (cache/prefix.py): shared
                                       # prompt prefixes skip prefill entirely
+    prefill_flash_warm: bool = True   # warm-prefix flash prefill: the
+                                      # serving engine's WARM prefill
+                                      # program (chunk continuations,
+                                      # prefix-cache resumes) compiles
+                                      # with the flash kernel attending
+                                      # cached prefix + fresh chunk,
+                                      # instead of the dense O(T*S)
+                                      # gather fallback; also lets a
+                                      # prefill gang mix fresh and warm
+                                      # members in one dispatch (the
+                                      # all-or-nothing freshness
+                                      # downgrade is gone). Only
+                                      # engages where kernels do
+                                      # (use_kernels, i.e. TPU by
+                                      # default); False = dense warm
+                                      # prefill, the parity reference
     kv_quant: str = "none"            # "int8" stores the contiguous KV
                                       # cache as int8 codes + per-vector
                                       # scales: half the HBM bytes in the

@@ -201,13 +201,19 @@ class ServingEngine:
             fwd = paged_forward
         prefill_cfg = self.cfg.replace(attn_impl="flash") \
             if use_kernels else self.cfg
-        # Two prefill programs: fresh (start==0, may take the flash kernel)
-        # and warm (chunk continuation — attends through the cache, dense).
+        # Two prefill programs: fresh (start==0, flash over the chunk
+        # alone) and warm (chunk continuation / prefix-hit resume).
+        # With runtime.prefill_flash_warm (default) the warm program
+        # compiles with the flash cfg too — the kernel attends cached
+        # prefix + fresh chunk (ISSUE 13) — else it keeps the dense
+        # gather fallback (the parity reference).
+        warm_cfg = prefill_cfg if self.runtime.prefill_flash_warm \
+            else self.cfg
         self._prefill = jax.jit(
             partial(_prefill_slot, prefill_cfg, True, fwd),
             donate_argnums=(2,))
         self._prefill_warm = jax.jit(
-            partial(_prefill_slot, self.cfg, False, fwd),
+            partial(_prefill_slot, warm_cfg, False, fwd),
             donate_argnums=(2,))
         self._decode = jax.jit(
             partial(_decode_all, self.cfg, fwd, use_kernel=use_kernels),
@@ -259,6 +265,27 @@ class ServingEngine:
     @property
     def num_slots(self) -> int:
         return self.runtime.max_batch_size
+
+    @property
+    def warm_prefill_flash(self) -> bool:
+        """True when the warm prefill program attends through the flash
+        kernel (cached prefix + fresh chunk) rather than the dense
+        gather fallback — kernels on AND runtime.prefill_flash_warm."""
+        return self._use_kernels and bool(self.runtime.prefill_flash_warm)
+
+    @property
+    def prefill_gang_split_fresh(self) -> bool:
+        """Must the scheduler split prefill gangs by freshness? Only
+        with prefill_flash_warm OFF — the seed behavior, where the warm
+        program was dense and mixing would drag cold members off the
+        flash path (or, kernels off, where splitting was merely
+        harmless). With warm-prefix flash on, a mixed gang rides ONE
+        dispatch and loses nothing: wherever kernels run the warm
+        program is flash too (fresh members ride with prefix_len 0),
+        and where they don't, both flavors compile the same dense
+        attention. The all-or-nothing freshness downgrade — a warm
+        member forcing the whole dispatch dense — is gone (ISSUE 13)."""
+        return not bool(self.runtime.prefill_flash_warm)
 
     def set_table_row(self, slot: int, pages) -> None:
         """Host allocator -> block table. The device never writes the
@@ -378,11 +405,14 @@ class ServingEngine:
         share a dispatch. B pads to the next power-of-two bucket
         (clamped at runtime.prefill_max_batch); padding rows carry a
         null-page table row, so their writes land on the null page and
-        their logits are discarded. The whole gang must agree on
-        freshness: all starts==0 dispatches the fresh program
-        (flash-kernel eligible), any warm member routes the gang through
-        the dense warm program — the scheduler groups members so this
-        never mixes.
+        their logits are discarded. An all-fresh gang (every start==0)
+        dispatches the fresh program (flash over the chunks alone); any
+        warm member routes the gang through the warm program — with
+        prefill_flash_warm that program is flash too (cached prefix +
+        fresh chunk, per-row start masking, so fresh members simply ride
+        with prefix_len 0) and gangs may mix freely; only when the warm
+        program is dense while kernels are on does the scheduler still
+        split gangs by freshness (prefill_gang_split_fresh).
         """
         B = len(slots)
         T = bucket_len(max(len(c) for c in chunks), hi=self.cache.max_seq)

@@ -29,6 +29,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from butterfly_tpu.core.config import ModelConfig
+# Module-level, deliberately: attention_block runs INSIDE traced code and
+# a lazy in-function import executes on every trace — the same per-trace
+# tax PR 12's quantize_kv hoist removed from cache/paged.py. No cycle:
+# ops.flash_attention imports nothing project-local at module level.
+from butterfly_tpu.ops.flash_attention import flash_attention_sharded
 from butterfly_tpu.quant.int8 import qeinsum
 
 Params = Dict[str, Any]
@@ -282,14 +287,16 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
 
     x: [B,T,D]; ck/cv: [B,S,Kv,H]; positions: [B,T]; mask: [B,T,S].
     `fresh` (static) asserts positions start at 0 and nothing LIVE
-    precedes this call's tokens — required to take the flash path,
-    which attends only over the freshly projected K/V. The cache
-    buffers may still hold stale bytes from a recycled pool
-    (engine cache reuse): correctness must come from position masking
-    and overwrite-before-attend, never from assuming zeroed buffers.
-    Warm multi-token calls (chunked prefill / continuation) fall back
-    to dense cache attention even when cfg.attn_impl == "flash", so
-    prior context is never silently dropped.
+    precedes this call's tokens — the flash path then attends only over
+    the freshly projected K/V. The cache buffers may still hold stale
+    bytes from a recycled pool (engine cache reuse): correctness must
+    come from position masking and overwrite-before-attend, never from
+    assuming zeroed buffers. Warm multi-token calls (chunked prefill /
+    continuation / prefix-hit resume) take the kernel too under
+    cfg.attn_impl == "flash" (ISSUE 13): the cache rides in as the
+    kernel's cached-prefix segment, count-masked per row at `start`, so
+    warm prefill stops paying the dense O(T*S) fallback; dense attend
+    stays as the non-flash path and the parity reference.
 
     int8 cache: pass codes ck/cv [B,Kv,S,H] + scales k_s/v_s [B,Kv,S];
     the return gains the updated scales — (out, ck, cv, k_s, v_s)
@@ -306,8 +313,6 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
         assert fresh, "no-cache attention_block is fresh-prefill only"
         out = None
         if cfg.attn_impl == "flash" and x.shape[1] > 1:
-            from butterfly_tpu.ops.flash_attention import (
-                flash_attention_sharded)
             out = flash_attention_sharded(q, k, v, causal=True)
         if out is None:
             out = attend(q, k, v, mask, cfg)
@@ -319,12 +324,31 @@ def attention_block(x: jax.Array, p: Params, cfg: ModelConfig,
     else:
         ck, cv = update_cache_layer(ck, cv, k, v, start)
     out = None
-    if cfg.attn_impl == "flash" and x.shape[1] > 1 and fresh:
-        from butterfly_tpu.ops.flash_attention import flash_attention_sharded
+    if cfg.attn_impl == "flash" and x.shape[1] > 1:
         # None = no mesh axis can shard the kernel operands; use dense.
         # (Fresh prefill attends over the just-projected bf16 K/V, so the
         # kernel path is identical for int8 caches.)
-        out = flash_attention_sharded(q, k, v, causal=True)
+        if fresh:
+            out = flash_attention_sharded(q, k, v, causal=True)
+        else:
+            # warm chunk (ISSUE 13): the kernel attends the cache as a
+            # prefix segment count-masked at `start` (the chunk's own
+            # just-written copy sits at >= start, excluded) plus the
+            # fresh chunk. int8 caches mirror the written representation
+            # for the chunk itself — quantize-dequantize the fresh K/V —
+            # so the operand set is element-wise identical to what the
+            # dense path reads back, the byte-parity argument.
+            kf, vf = k, v
+            if k_s is not None:
+                kq, ksc = quantize_kv(k)
+                vq, vsc = quantize_kv(v)
+                kf = (kq.astype(jnp.float32)
+                      * ksc[..., None]).astype(k.dtype)
+                vf = (vq.astype(jnp.float32)
+                      * vsc[..., None]).astype(v.dtype)
+            out = flash_attention_sharded(
+                q, kf, vf, causal=True, prefix_k=ck, prefix_v=cv,
+                prefix_len=start, prefix_k_scale=k_s, prefix_v_scale=v_s)
     if out is None:
         out = attend(q, ck, cv, mask, cfg, k_s, v_s)
     if k_s is not None:

@@ -123,6 +123,7 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                           prefill_max_batch: Optional[int] = None,
                           inflight_blocks: int = 2,
                           kv_write_combine: bool = True,
+                          prefill_flash_warm: bool = True,
                           isolated_decode_tok_s_chip: Optional[float] = None,
                           seed: int = 0) -> Dict:
     """Benchmark the PRODUCT serving path: Scheduler + ServingEngine with
@@ -152,7 +153,8 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                        kv_quant=kv_quant,
                        decode_steps_per_tick=decode_steps_per_tick,
                        inflight_blocks=inflight_blocks,
-                       kv_write_combine=kv_write_combine)
+                       kv_write_combine=kv_write_combine,
+                       prefill_flash_warm=prefill_flash_warm)
     if prefill_max_batch is not None:
         rt = rt.replace(prefill_max_batch=prefill_max_batch)
     engine = ServingEngine(model, params, rt)
@@ -296,6 +298,87 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     return out
 
 
+def run_warm_prefill_benchmark(model, params, *, n_requests: int = 6,
+                               prompt_len: int = 640,
+                               prefill_chunk: int = 256,
+                               max_new: int = 2, max_batch: int = 4,
+                               page_size: int = 16, kv_quant: str = "none",
+                               use_kernels: Optional[bool] = None,
+                               repeats: int = 5, seed: int = 0) -> Dict:
+    """Warm chunked-prefill phase (ISSUE 13): long prompts (>= 512)
+    whose prefill spans multiple `prefill_chunk`-sized chunks, so every
+    chunk after the first runs the WARM path and admission rounds mix
+    warm continuations with fresh arrivals. Two legs at the same
+    operating point:
+
+    * ON (`prefill_flash_warm`, the default): wherever kernels run the
+      warm program attends through the flash kernel (cached prefix +
+      fresh chunk), and mixed gangs ride one dispatch.
+    * OFF (`_dense` suffix): the pre-ISSUE-13 behavior — dense
+      O(T*S_max) warm attention with materialized scores/masks, and
+      gangs split by freshness (the all-or-nothing downgrade).
+
+    Emits the on/off pair the bench JSON carries (PR 12's `_nowin`
+    pattern): warm_prefill_ttft_p50/p95 + warm_prefill_tokens_per_sec
+    with `_dense` twins, plus `warm_prefill_kernelized` saying whether
+    the on leg actually took the kernel (False on CPU, where kernels
+    are TPU-only and the measured delta is the gang-merge half of the
+    change; the kernel half is still exercised bit-exactly by the
+    interpret-mode parity tests). TTFT medians are over `repeats`
+    backlog drains — a single CPU drain carries scheduler jitter larger
+    than the effect (the PR 12 median-of-3 lesson).
+    """
+    from butterfly_tpu.core.config import RuntimeConfig
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    rng = np.random.RandomState(seed)
+    V = model.cfg.vocab_size
+    prompts = [rng.randint(1, V, (prompt_len,)).tolist()
+               for _ in range(n_requests)]
+    out: Dict = {
+        "warm_prefill_prompt_len": prompt_len,
+        "warm_prefill_chunk": prefill_chunk,
+        "warm_prefill_requests": n_requests,
+        "warm_prefill_kv_quant": kv_quant,
+    }
+    for flag, suffix in ((True, ""), (False, "_dense")):
+        rt = RuntimeConfig(max_batch_size=max_batch,
+                           max_seq_len=prompt_len + max_new + 16,
+                           page_size=page_size, kv_quant=kv_quant,
+                           prefill_chunk=prefill_chunk,
+                           prefill_max_batch=max_batch,
+                           prefill_flash_warm=flag)
+        engine = ServingEngine(model, params, rt, use_kernels=use_kernels)
+        if flag:
+            out["warm_prefill_kernelized"] = engine.warm_prefill_flash
+        ttft50, ttft95, walls = [], [], []
+        for rep in range(repeats + 1):
+            sched = Scheduler(engine)
+            reqs = [sched.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            t0 = time.monotonic()
+            sched.run_until_done(max_ticks=10 ** 6)
+            dt = time.monotonic() - t0
+            unfinished = [r.id for r in reqs if r.state != "finished"]
+            if unfinished:
+                raise RuntimeError(
+                    f"warm-prefill benchmark left requests unfinished "
+                    f"(ids {unfinished[:8]})")
+            if rep == 0:
+                continue  # compile warmup drain, off the clock
+            m = sched.metrics()
+            ttft50.append(m["ttft_p50"])
+            ttft95.append(m["ttft_p95"])
+            walls.append(dt)
+        total_prompt_tokens = n_requests * prompt_len
+        out["warm_prefill_ttft_p50" + suffix] = float(np.median(ttft50))
+        out["warm_prefill_ttft_p95" + suffix] = float(np.median(ttft95))
+        out["warm_prefill_tokens_per_sec" + suffix] = \
+            total_prompt_tokens / float(np.median(walls))
+    return out
+
+
 def run_spec_benchmark(model, params, *, n_requests: int = 8,
                        prompt_len: int = 32, max_new: int = 64,
                        max_batch: int = 4, gamma: int = 4, ngram: int = 2,
@@ -400,6 +483,7 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
                         inflight_blocks: int = 2,
                         grid=None, kv_quant: str = "none",
                         prefill_max_batch: Optional[int] = None,
+                        prefill_flash_warm: bool = True,
                         slo_ttft_ms: Optional[float] = 1000.0,
                         deadline_ms: Optional[float] = 30000.0,
                         arrival: Optional[str] = None,
@@ -463,7 +547,8 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
                             kv_quant=kv_quant,
                             decode_steps_per_tick=decode_steps_per_tick,
                             inflight_blocks=inflight_blocks,
-                            prefix_caching=True)
+                            prefix_caching=True,
+                            prefill_flash_warm=prefill_flash_warm)
     if prefill_max_batch is not None:
         base_rt = base_rt.replace(prefill_max_batch=prefill_max_batch)
     engine = ServingEngine(model, params, base_rt)

@@ -13,11 +13,19 @@ star attributes to the original design"). Design:
   -inf mask — no branch divergence, MXU stays busy on the diagonal).
 * GQA: q head n reads k/v head n // (Nq/Kv) via the k/v index maps — no
   materialized head broadcast.
+* Warm-prefix prefill (ISSUE 13): chunk continuations / prefix-cache
+  resumes hand the kernel the CACHED context (a gathered pool view or a
+  contiguous cache slice, float or int8 codes + scales) as extra
+  reduction-axis blocks AHEAD of the causal fresh-chunk blocks, per-row
+  count-masked at the scalar-prefetched `start` — the append-to-KV-
+  history attention shape online softmax was built for, replacing the
+  dense O(T*S) warm fallback.
 * Off-TPU the wrapper runs the same kernel in interpreter mode, so CPU
   tests validate the exact kernel code path numerics.
 
-Used by the engine for fresh prefills (cfg.attn_impl="flash"); decode-side
-paged attention lives in ops/paged_attention.py.
+Used by the engine for fresh AND warm multi-token prefills
+(cfg.attn_impl="flash"); decode-side paged attention lives in
+ops/paged_attention.py.
 """
 from __future__ import annotations
 
@@ -34,6 +42,25 @@ if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover
     pltpu.CompilerParams = pltpu.TPUCompilerParams
 
 NEG_INF = -1e30
+
+
+def _block_update(s, mask, vf, m_ref, l_ref, acc_ref, vs_row=None):
+    """One online-softmax accumulation step shared by the fresh-chunk
+    blocks and the cached-prefix segment (the same recurrence
+    ops/paged_attention.py uses for its page/window blocks): s [BQ, C]
+    raw scores, mask [BQ, C] (True = attend), vf [C, H] values, vs_row
+    optional [1, C] V scales folded into the probs (int8 prefix)."""
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    if vs_row is not None:
+        p = p * vs_row                                 # V scale into probs
+    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+        p, vf, preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
@@ -59,21 +86,84 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     mask = k_pos < seq_len                          # padded keys
     if causal:
         mask = mask & (q_pos >= k_pos)
-    s = jnp.where(mask, s, NEG_INF)
-
-    m_prev, l_prev = m_ref[:], l_ref[:]
-    m_blk = jnp.max(s, axis=-1, keepdims=True)      # [BQ, 1]
-    m_new = jnp.maximum(m_prev, m_blk)
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-        p, v, preferred_element_type=jnp.float32)
-    m_ref[:] = m_new
-    l_ref[:] = l_new
+    _block_update(s, mask, v, m_ref, l_ref, acc_ref)
 
     @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_warm_kernel(start_ref, q_ref, k_ref, v_ref, *rest,
+                       bq: int, bk: int, bp: int, np_blocks: int,
+                       seq_len: int, quant: bool):
+    """Warm-prefix flash prefill kernel (ISSUE 13): the reduction axis
+    runs `np_blocks` cached-prefix blocks — read from the contiguous
+    cache view, masked per row by the scalar-prefetched `start` (the
+    count of live cached tokens; garbage past it never contributes) —
+    AHEAD of the causal fresh-chunk blocks, all sharing one
+    online-softmax state (`_block_update`, PR 12's window-segment
+    pattern). Every valid prefix position precedes every query's
+    absolute position (queries sit at start..start+T-1), so the prefix
+    needs only the `< start` count mask, no causal triangle. Blocks
+    entirely past a row's `start` skip their compute via `pl.when`
+    (the DMA still runs, like the paged kernel's dead-page blocks).
+
+    quant: the prefix arrives as int8 codes with per-vector scales
+    (the pool representation) — K scales multiply the score columns
+    output-side, V scales fold into the probs, exactly like
+    models.common.attend / the paged kernel's int8 blocks. The fresh
+    chunk is always float (the caller mirrors the cache's
+    quantize-dequantize there for operand parity with the dense path).
+    """
+    pk_ref, pv_ref, *rest = rest
+    pks_ref = pvs_ref = None
+    if quant:
+        pks_ref, pvs_ref, *rest = rest
+    o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    i = pl.program_id(2)          # q block
+    j = pl.program_id(3)          # reduction axis: prefix then fresh
+    nj = pl.num_programs(3)
+    start = start_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when((j < np_blocks) & (j * bp < start))
+    def _prefix():
+        q = q_ref[0, 0].astype(jnp.float32)        # [BQ, H]
+        kf = pk_ref[0, 0].astype(jnp.float32)      # [BP, H]
+        vf = pv_ref[0, 0].astype(jnp.float32)
+        scale = jax.lax.rsqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32)
+        if quant:
+            s = s * pks_ref[0, 0]                  # [1, BP] K scale columns
+        s = s * scale
+        cols = j * bp + jax.lax.broadcasted_iota(jnp.int32, (bq, bp), 1)
+        mask = cols < start
+        _block_update(s, mask, vf, m_ref, l_ref, acc_ref,
+                      pvs_ref[0, 0] if quant else None)
+
+    @pl.when(j >= np_blocks)
+    def _fresh():
+        jf = j - np_blocks
+        q = q_ref[0, 0].astype(jnp.float32)
+        kf = k_ref[0, 0].astype(jnp.float32)
+        vf = v_ref[0, 0].astype(jnp.float32)
+        scale = jax.lax.rsqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        s = jnp.dot(q, kf.T, preferred_element_type=jnp.float32) * scale
+        # chunk-relative causality: absolute positions share the row's
+        # start offset, so the relative triangle is exact
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = jf * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (k_pos < seq_len) & (q_pos >= k_pos)
+        _block_update(s, mask, vf, m_ref, l_ref, acc_ref)
+
+    @pl.when(j == nj - 1)
     def _finalize():
         o_ref[0, 0] = (acc_ref[:] /
                        jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
@@ -86,13 +176,24 @@ def _auto_axes(mesh) -> set:
             if t == AxisType.Auto}
 
 
+def _abstract_mesh():
+    """The ambient abstract mesh, or None on jax < 0.5: 0.4.x has no
+    jax.sharding.get_abstract_mesh — and no jax.set_mesh to install an
+    ambient mesh in the first place, so "no mesh" is the truth there,
+    not a guess. Same compat class as the TPUCompilerParams alias above
+    (without it, every use_kernels serving path dies on 0.4.37 before
+    a single kernel runs)."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    return get() if get is not None else None
+
+
 def shardable_axes(batch: int, nq: int, kv: int):
     """(data_axis, tensor_axis) of the ambient mesh usable to shard an
     attention operand set: `data` must divide the batch/slot dim, `tensor`
     must divide both head counts; an axis is skipped when absent, size 1,
     or already Manual from an enclosing shard_map (e.g. the pipeline's
     `stage`). Shared eligibility rule for both kernel wrappers."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = _abstract_mesh()
     if mesh is None or mesh.empty:
         return None, None
     auto = _auto_axes(mesh)
@@ -108,14 +209,19 @@ def live_auto_mesh() -> bool:
     """True when the ambient mesh has any multi-device axis still under
     GSPMD (Auto) control — a bare pallas_call traced there would be an
     opaque custom call the partitioner can't shard."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = _abstract_mesh()
     if mesh is None or mesh.empty:
         return False
     return any(mesh.shape[n] > 1 for n in _auto_axes(mesh))
 
 
 def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
-                            causal: bool = True) -> jax.Array:
+                            causal: bool = True,
+                            prefix_k: jax.Array = None,
+                            prefix_v: jax.Array = None,
+                            prefix_len: jax.Array = None,
+                            prefix_k_scale: jax.Array = None,
+                            prefix_v_scale: jax.Array = None) -> jax.Array:
     """Mesh-aware flash attention (SURVEY.md §7 stages 4/6).
 
     A pallas_call is an opaque custom call GSPMD cannot partition, so under
@@ -133,6 +239,13 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     path there (a bare pallas_call under GSPMD is an opaque custom call
     — the failure mode the engines' old mesh-disables-kernels guard
     existed to prevent).
+
+    Warm-prefix prefill (ISSUE 13): prefix_k/prefix_v + prefix_len give
+    the kernel a cached-context segment ahead of the fresh chunk (see
+    flash_attention). The cache/scale operands shard on the same axes —
+    batch/slots over `data`, kv heads over `tensor`
+    (parallel/partition.py warm_prefix_specs, matching the pool
+    sharding paged_cache_specs assigns).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -142,13 +255,40 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     if d is None and t is None:
         if live_auto_mesh():
             return None
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               prefix_k=prefix_k, prefix_v=prefix_v,
+                               prefix_len=prefix_len,
+                               prefix_k_scale=prefix_k_scale,
+                               prefix_v_scale=prefix_v_scale)
     spec = P(d, None, t, None)
+    if prefix_k is None:
+        fn = jax.shard_map(
+            functools.partial(flash_attention, causal=causal),
+            in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={a for a in (d, t) if a is not None},
+            check_vma=False)
+        return fn(q, k, v)
+    # lazy: partition imports models.common at module level, which now
+    # imports this module — an import here would close the cycle
+    from butterfly_tpu.parallel.partition import warm_prefix_specs
+    quant = prefix_k_scale is not None
+    args = [q, k, v, prefix_k, prefix_v, prefix_len]
+    if quant:
+        args += [prefix_k_scale, prefix_v_scale]
+
+    def _warm(q, k, v, pk, pv, plen, *scales):
+        kw = {}
+        if scales:
+            kw = dict(prefix_k_scale=scales[0], prefix_v_scale=scales[1])
+        return flash_attention(q, k, v, causal=causal, prefix_k=pk,
+                               prefix_v=pv, prefix_len=plen, **kw)
+
     fn = jax.shard_map(
-        functools.partial(flash_attention, causal=causal),
-        in_specs=(spec, spec, spec), out_specs=spec,
+        _warm,
+        in_specs=(spec, spec, spec) + warm_prefix_specs(d, t, quant),
+        out_specs=spec,
         axis_names={a for a in (d, t) if a is not None}, check_vma=False)
-    return fn(q, k, v)
+    return fn(*args)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -156,11 +296,32 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 128,
                     block_k: int = 128,
-                    interpret: bool | None = None) -> jax.Array:
+                    interpret: bool | None = None,
+                    prefix_k: jax.Array = None,
+                    prefix_v: jax.Array = None,
+                    prefix_len: jax.Array = None,
+                    prefix_k_scale: jax.Array = None,
+                    prefix_v_scale: jax.Array = None) -> jax.Array:
     """Blockwise (flash) attention over fresh Q/K/V.
 
     q: [B, T, Nq, H]; k/v: [B, T, Kv, H] (same T: self-attention).
     Returns [B, T, Nq, H] in q.dtype. Softmax/accum in f32.
+
+    Warm-prefix prefill (ISSUE 13): prefix_k/prefix_v hand the kernel a
+    CACHED-CONTEXT segment attended ahead of the (causal) fresh chunk —
+    the append-to-KV-history shape chunked/warm prefill needs, in the
+    same representation models.common.attend consumes:
+
+    * float view [B, Sp, Kv, H] (a gathered pool view or a contiguous
+      cache slice), or
+    * int8 codes [B, Kv, Sp, H] with per-vector scales
+      prefix_k_scale/prefix_v_scale [B, Kv, Sp] dequantized in-kernel.
+
+    prefix_len [B] int32 is each row's live cached-token count
+    (scalar-prefetched; positions at or past it — recycled-buffer
+    garbage, batch padding rows, the chunk's own already-written copy —
+    never contribute). Queries sit at absolute positions
+    prefix_len[b] + 0..T-1, so `causal` must be True.
     """
     B, T, Nq, H = q.shape
     Kv = k.shape[2]
@@ -182,6 +343,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Tq - T), (0, 0)))
     kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Tk - T), (0, 0)))
     vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Tk - T), (0, 0)))
+
+    if prefix_k is not None:
+        if not causal:
+            raise ValueError("warm-prefix flash attention is causal-only")
+        out = _flash_warm_call(qt, kt, vt, prefix_k, prefix_v, prefix_len,
+                               prefix_k_scale, prefix_v_scale, T=T, bq=bq,
+                               bk=bk, block_k=block_k, G=G,
+                               interpret=interpret)
+        return jnp.moveaxis(out[:, :, :T, :], 1, 2)  # [B, T, Nq, H]
 
     grid = (B, Nq, Tq // bq, Tk // bk)
     kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, seq_len=T,
@@ -210,3 +380,90 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret=interpret,
     )(qt, kt, vt)
     return jnp.moveaxis(out[:, :, :T, :], 1, 2)     # [B, T, Nq, H]
+
+
+def _flash_warm_call(qt, kt, vt, prefix_k, prefix_v, prefix_len,
+                     prefix_k_scale, prefix_v_scale, *, T: int, bq: int,
+                     bk: int, block_k: int, G: int, interpret: bool):
+    """Build + dispatch the warm-prefix pallas_call. qt/kt/vt arrive
+    head-major and padded ([B, N, Tq/Tk, H]); returns [B, Nq, Tq, H].
+
+    The prefix canonicalizes to kv-major [B, Kv, Sp, H] (the int8 pool
+    order; the float view moveaxes into it, the same relayout the q/k/v
+    operands already pay) and pads Sp to the prefix block. The per-row
+    `start` vector rides as the one scalar-prefetch operand so the
+    BlockSpec index maps and the in-kernel masks see it before the body
+    runs (the paged kernel's PrefetchScalarGridSpec pattern)."""
+    B, Nq, Tq, H = qt.shape
+    Kv = kt.shape[1]
+    quant = prefix_k_scale is not None
+    if quant:
+        pk, pv = prefix_k, prefix_v            # [B, Kv, Sp, H] codes
+    else:
+        pk = jnp.moveaxis(prefix_k, 2, 1)      # [B, Sp, Kv, H] -> kv-major
+        pv = jnp.moveaxis(prefix_v, 2, 1)
+    Sp = pk.shape[2]
+    bp = min(block_k, -(-max(Sp, 8) // 8) * 8)
+    Sp_pad = -(-Sp // bp) * bp
+    np_blocks = Sp_pad // bp
+    nf = kt.shape[2] // bk
+    pk = jnp.pad(pk, ((0, 0), (0, 0), (0, Sp_pad - Sp), (0, 0)))
+    pv = jnp.pad(pv, ((0, 0), (0, 0), (0, Sp_pad - Sp), (0, 0)))
+
+    def q_map(b, n, i, j, st):
+        return (b, n, i, 0)
+
+    def k_map(b, n, i, j, st):
+        # prefix steps clamp to fresh block 0 (DMA runs, block unused)
+        return (b, n // G, jnp.clip(j - np_blocks, 0, nf - 1), 0)
+
+    def p_map(b, n, i, j, st):
+        # fresh steps clamp to the last prefix block (unused)
+        return (b, n // G, jnp.minimum(j, np_blocks - 1), 0)
+
+    def ps_map(b, n, i, j, st):
+        return (b, n // G, 0, jnp.minimum(j, np_blocks - 1))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, H), q_map),
+        pl.BlockSpec((1, 1, bk, H), k_map),
+        pl.BlockSpec((1, 1, bk, H), k_map),
+        pl.BlockSpec((1, 1, bp, H), p_map),
+        pl.BlockSpec((1, 1, bp, H), p_map),
+    ]
+    args = [qt, kt, vt, pk, pv]
+    if quant:
+        # [B, Kv, Sp] -> [B, Kv, 1, Sp] (free bitcast): a (1, 1, bp)
+        # block of the 3-D array would put a size-1 sublane against Kv;
+        # (1, 1, 1, bp) of the 4-D form matches the array (the paged
+        # kernel's flat-scale-row trick)
+        pks = jnp.pad(prefix_k_scale, ((0, 0), (0, 0), (0, Sp_pad - Sp)))
+        pvs = jnp.pad(prefix_v_scale, ((0, 0), (0, 0), (0, Sp_pad - Sp)))
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, bp), ps_map),
+            pl.BlockSpec((1, 1, 1, bp), ps_map),
+        ]
+        args += [pks.reshape(B, Kv, 1, Sp_pad),
+                 pvs.reshape(B, Kv, 1, Sp_pad)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Nq, Tq // bq, np_blocks + nf),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, bq, H), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),       # running max
+            pltpu.VMEM((bq, 1), jnp.float32),       # running denom
+            pltpu.VMEM((bq, H), jnp.float32),       # accumulator
+        ],
+    )
+    kernel = functools.partial(_flash_warm_kernel, bq=bq, bk=bk, bp=bp,
+                               np_blocks=np_blocks, seq_len=T, quant=quant)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Nq, Tq, H), qt.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(prefix_len.astype(jnp.int32), *args)

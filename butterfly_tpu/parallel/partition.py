@@ -205,6 +205,27 @@ def shard_kv_window(window, cfg: ModelConfig, mesh: Mesh):
     return jax.device_put(window, to_shardings(specs, mesh))
 
 
+def warm_prefix_specs(d: Optional[str], t: Optional[str],
+                      quant: bool) -> Tuple:
+    """In_specs for the warm-prefix flash kernel's cached-context
+    operands (ops/flash_attention.py warm-prefix prefill, ISSUE 13), in
+    call order: (prefix_k, prefix_v, prefix_len[, k_scale, v_scale]).
+
+    The prefix is the cache in the representation attend() consumes —
+    float view [B, S, Kv, H], or int8 codes [B, Kv, S, H] + per-vector
+    scales [B, Kv, S] — so batch/slots shard over `data` with the q
+    rows and kv heads over `tensor` with the pools, exactly the axes
+    paged_cache_specs/cache_specs give the backing cache. `d`/`t` are
+    the axis names shardable_axes resolved for this call site (None =
+    replicated), not a mesh: the kernel wrapper picks them per dispatch.
+    """
+    if quant:
+        code = P(d, t, None, None)
+        return (code, code, P(d), P(d, t, None), P(d, t, None))
+    view = P(d, None, t, None)
+    return (view, view, P(d))
+
+
 def activation_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
     """[B,T,D] activations: batch over data, optionally seq over `seq`."""
     return P(_div_any(mesh, "data"), "seq" if seq_sharded and

@@ -827,7 +827,9 @@ class Scheduler:
         prefill group (bounded by free slots, pages, prefill_max_batch,
         and the remaining token budget), pack every member's next chunk
         under the budget FCFS, and dispatch the chunks as batched
-        [B, Tbucket] prefills bucketed by (freshness, chunk length).
+        [B, Tbucket] prefills bucketed by chunk length (plus freshness
+        only when engine.prefill_gang_split_fresh — the seed rule,
+        kept for prefill_flash_warm=False).
 
         Returns the number of prompt tokens dispatched, or None if no
         progress was possible (nothing admissible and nothing to
@@ -892,15 +894,21 @@ class Scheduler:
 
         # bucket by (freshness, padded chunk length): members sharing a
         # bucket ride ONE [B, Tbucket] dispatch. Freshness splits the
-        # gang because `fresh` is a static program flag (flash-kernel
-        # eligibility) — a warm prefix-cache or carried member never
-        # drags cold members off the flash path.
+        # gang ONLY when the engine's fresh program is kernelized but
+        # its warm one is dense (prefill_gang_split_fresh) — there a
+        # warm prefix-cache or carried member would drag cold members
+        # off the flash path. With warm-prefix flash (ISSUE 13, the
+        # default where kernels run) the warm program takes the kernel
+        # too, so mixed gangs ride one dispatch and the all-or-nothing
+        # freshness downgrade is gone.
+        split_fresh = self.engine.prefill_gang_split_fresh
         hi = self.engine.cache.max_seq
         dispatches: Dict[tuple, List[tuple]] = {}
         for req, chunk, start in plan:
-            key = (start == 0, bucket_len(len(chunk), hi=hi))
+            key = (start == 0 if split_fresh else True,
+                   bucket_len(len(chunk), hi=hi))
             dispatches.setdefault(key, []).append((req, chunk, start))
-        for (fresh, bucket), members in dispatches.items():
+        for (_, bucket), members in dispatches.items():
             self._h_prefill_batch.observe(len(members))
             if self.trace is not None:
                 self.trace.event(None, "prefill_batch",
@@ -908,7 +916,7 @@ class Scheduler:
                                  slots=[m[0].slot for m in members],
                                  bucket=bucket,
                                  tokens=sum(len(m[1]) for m in members),
-                                 fresh=fresh)
+                                 fresh=all(m[2] == 0 for m in members))
                 for req, chunk, start in members:
                     self.trace.event(req.id, "prefill_chunk",
                                      start=start, tokens=len(chunk))

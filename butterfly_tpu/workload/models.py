@@ -323,7 +323,7 @@ def mixed_chat(*, page_size: int = 16, vocab: int = 258,
                deadline_ms: Optional[float] = None) -> Workload:
     """The canned preemption-forcing mixed workload (ISSUE 10).
 
-    Four cohorts modeling a chat service's production mix:
+    Five cohorts modeling a chat service's production mix:
 
     * ``chat`` (45%) — the main interactive population: two shared
       template pages (system prompt), lognormal prompts/responses.
@@ -334,6 +334,13 @@ def mixed_chat(*, page_size: int = 16, vocab: int = 258,
       summarization: the shed-first, preempt-first class.
     * ``probe`` (15%) — short interactive probes; carries the
       workload's deadline budget when one is declared.
+    * ``long_doc`` (10%, ISSUE 13) — the top of the prompt range
+      (prompt_hi/2..prompt_hi; 512-1024 at the TPU sizing), batch
+      priority, near-minimal decode budget: prompts that exceed
+      prefill_chunk and so CHUNK across scheduler ticks, putting the
+      warm-prefix prefill path (flash cached-prefix kernel vs dense
+      fallback) under the mixed bench's clock — ROADMAP item 5's
+      long-doc cohort.
 
     Prompt lengths span [prompt_lo, prompt_hi] (default 32-1024),
     decode budgets [max_new_lo, max_new_hi] — heterogeneous enough
@@ -366,6 +373,10 @@ def mixed_chat(*, page_size: int = 16, vocab: int = 258,
                    Uniform(prompt_lo, min(prompt_hi, 2 * prompt_lo)),
                    Uniform(max_new_lo, max(max_new_lo, max_new_hi // 2)),
                    deadline_ms=deadline_ms),
+            Cohort("long_doc", 0.10,
+                   Uniform(max(prompt_lo, prompt_hi // 2), prompt_hi),
+                   Uniform(max_new_lo, max(max_new_lo, max_new_hi // 8)),
+                   priority="batch"),
         ))
 
 

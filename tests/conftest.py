@@ -117,6 +117,11 @@ SLOW_TESTS = {
     "test_pipelined_greedy_parity_vs_synchronous",
     "test_pipelined_greedy_parity_fused_k8",
     "test_pipelined_parity_under_page_pressure",
+    # warm-prefix flash prefill grid (ISSUE 13): 3 engine compiles per
+    # param (the fast tier still pins the contract directly: the kernel
+    # units, the chunked vs-dense parity, the prefix-hit resume, and
+    # the dispatch-policy tests all run fast-tier)
+    "test_warm_flash_parity_grid",
     # write-combined KV window grids: 8 (resp. 4) scheduler compiles
     # each (the fast tier still pins the contract directly:
     # kv_write_combine defaults on so EVERY parity test above decodes

@@ -579,22 +579,33 @@ def test_batched_prefill_budget_and_carry():
 
 
 def test_mixed_warm_cold_group_admission():
-    """A gang containing a prefix-cache-warm member (start > 0, rides
-    the dense warm program) and a cold member (start == 0, flash-
-    eligible fresh program) dispatches them in separate freshness
-    buckets and both match their references."""
-    sched, params = make_sched(max_batch=4, max_seq=64, page=8,
-                               prefix_caching=True, prefill_max_batch=4)
-    shared = list(range(1, 17))  # two full pages
-    r0 = sched.submit(shared + [5], max_new_tokens=4)
-    sched.run_until_done()
-    rw = sched.submit(shared + [9], max_new_tokens=6)  # warm: hits r0's pages
-    rc = sched.submit([7, 3, 2], max_new_tokens=6)     # cold
-    sched.tick()
-    assert rw.cached_at_admit == 16 and rc.cached_at_admit == 0
-    sched.run_until_done()
-    assert rw.output == ref_tokens(params, shared + [9], 6)
-    assert rc.output == ref_tokens(params, [7, 3, 2], 6)
+    """A gang containing a prefix-cache-warm member (start > 0) and a
+    cold member (start == 0): with warm-prefix flash (the default) the
+    mixed gang rides the warm program together — freshness no longer
+    splits it (ISSUE 13) — and with prefill_flash_warm=False the seed
+    behavior returns (separate freshness buckets, so a warm member
+    never drags cold members off the flash path). Both members match
+    their references either way."""
+    for warm_flash in (True, False):
+        sched, params = make_sched(max_batch=4, max_seq=64, page=8,
+                                   prefix_caching=True, prefill_max_batch=4,
+                                   prefill_flash_warm=warm_flash)
+        shared = list(range(1, 17))  # two full pages
+        r0 = sched.submit(shared + [5], max_new_tokens=4)
+        sched.run_until_done()
+        n0 = sched.registry.get("prefill_batch_size").count
+        rw = sched.submit(shared + [9], max_new_tokens=6)  # warm: prefix hit
+        rc = sched.submit([7, 3, 2], max_new_tokens=6)     # cold
+        sched.tick()
+        assert rw.cached_at_admit == 16 and rc.cached_at_admit == 0
+        # chunk lengths share the 16-token bucket, so the dispatch count
+        # pins the gang-freshness rule directly: merged = ONE dispatch,
+        # split (the seed rule) = one per freshness flavor
+        n_disp = sched.registry.get("prefill_batch_size").count - n0
+        assert n_disp == (1 if warm_flash else 2)
+        sched.run_until_done()
+        assert rw.output == ref_tokens(params, shared + [9], 6)
+        assert rc.output == ref_tokens(params, [7, 3, 2], 6)
 
 
 def test_preempt_partially_prefilled_group_member():

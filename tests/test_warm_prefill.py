@@ -1,0 +1,296 @@
+"""Warm-prefix flash prefill (ISSUE 13): kernel + serving-path parity.
+
+The warm multi-token prefill path (chunk continuations, prefix-cache
+resumes, warm gang members) dispatches the flash kernel with a cached-
+prefix segment instead of the dense O(T*S_max) fallback. Contract:
+
+* kernel level — the prefix segment folds into the online softmax
+  exactly like an inserted dense view, per-row count-masked at `start`
+  (garbage past it NEVER contributes: recycled buffers are not zeroed);
+* serving level — greedy outputs are token-identical to the dense path
+  across fresh/warm x chunk sizes x int8/f32 cache x ragged-start gangs
+  with padding rows x prefix-hit resume;
+* policy level — prefill gangs stop splitting by freshness when the
+  warm program is flash-capable (prefill_flash_warm), and
+  prefill_flash_warm=False restores the seed behavior exactly.
+
+Interpret mode runs the exact kernel code path on CPU (tier-1).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.engine.serving import ServingEngine
+from butterfly_tpu.models.common import (Model, attend, forward, init_cache,
+                                         quantize_kv)
+from butterfly_tpu.ops.flash_attention import flash_attention
+from butterfly_tpu.sched.scheduler import Scheduler
+
+CFG = tiny("llama", dtype="float32", param_dtype="float32")
+
+
+# ---------------------------------------------------------------------------
+# Kernel units (interpret mode = the exact kernel code path)
+# ---------------------------------------------------------------------------
+
+
+def _dense_warm_ref(q, k, v, pk, pv, start):
+    """Dense reference: fresh chunk inserted into the prefix view at each
+    row's start, causal mask over absolute positions."""
+    B, T = q.shape[:2]
+    Sp = pk.shape[1]
+    rows = []
+    for b in range(B):
+        S = Sp + T
+        kk = jnp.zeros((S,) + pk.shape[2:]).at[:Sp].set(pk[b])
+        vv = jnp.zeros((S,) + pv.shape[2:]).at[:Sp].set(pv[b])
+        s = int(start[b])
+        kk = kk.at[s:s + T].set(k[b])
+        vv = vv.at[s:s + T].set(v[b])
+        pos = s + jnp.arange(T)
+        mask = (jnp.arange(S)[None, :] <= pos[:, None])[None]
+        rows.append(attend(q[b:b + 1], kk[None], vv[None], mask, None)[0])
+    return jnp.stack(rows)
+
+
+def test_warm_prefix_kernel_parity_and_garbage():
+    """Float prefix segment: parity with the dense insert reference over
+    ragged starts (including 0 = a fresh/padding row riding the warm
+    program), and garbage past `start` must not change one bit."""
+    B, T, Nq, Kv, H, Sp = 3, 12, 4, 2, 16, 40
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    q = jax.random.normal(ks[0], (B, T, Nq, H))
+    k = jax.random.normal(ks[1], (B, T, Kv, H))
+    v = jax.random.normal(ks[2], (B, T, Kv, H))
+    pk = jax.random.normal(ks[3], (B, Sp, Kv, H))
+    pv = jax.random.normal(ks[4], (B, Sp, Kv, H))
+    start = jnp.asarray([7, 0, 33], jnp.int32)
+
+    out = flash_attention(q, k, v, block_q=8, block_k=8,
+                          prefix_k=pk, prefix_v=pv, prefix_len=start)
+    ref = _dense_warm_ref(q, k, v, pk, pv, start)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # poison the prefix past each row's start: bit-identical output
+    poisoned = pk
+    for b, s in enumerate([7, 0, 33]):
+        poisoned = poisoned.at[b, s:].set(1e3)
+    out2 = flash_attention(q, k, v, block_q=8, block_k=8,
+                          prefix_k=poisoned, prefix_v=pv, prefix_len=start)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out))
+
+
+def test_warm_prefix_kernel_int8_parity():
+    """int8 prefix (codes [B,Kv,Sp,H] + per-vector scales, the pool
+    representation): in-kernel dequantization matches the dense attend
+    over the dequantized view."""
+    B, T, Nq, Kv, H, Sp = 2, 10, 4, 2, 16, 24
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    q = jax.random.normal(ks[0], (B, T, Nq, H))
+    k = jax.random.normal(ks[1], (B, T, Kv, H))
+    v = jax.random.normal(ks[2], (B, T, Kv, H))
+    pkf = jax.random.normal(ks[3], (B, Sp, Kv, H))
+    pvf = jax.random.normal(ks[4], (B, Sp, Kv, H))
+    start = jnp.asarray([17, 5], jnp.int32)
+
+    kq, ksc = quantize_kv(pkf)          # [B,Sp,Kv,H] codes, [B,Sp,Kv]
+    vq, vsc = quantize_kv(pvf)
+    out = flash_attention(
+        q, k, v, block_q=8, block_k=8,
+        prefix_k=jnp.moveaxis(kq, 2, 1), prefix_v=jnp.moveaxis(vq, 2, 1),
+        prefix_len=start,
+        prefix_k_scale=jnp.moveaxis(ksc, 2, 1),
+        prefix_v_scale=jnp.moveaxis(vsc, 2, 1))
+    ref = _dense_warm_ref(q, k, v,
+                          kq.astype(jnp.float32) * ksc[..., None],
+                          vq.astype(jnp.float32) * vsc[..., None], start)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Serving-path parity
+# ---------------------------------------------------------------------------
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, CFG.vocab_size - 2, (n,)).tolist() for n in lens]
+
+
+def _run(model, params, prompts, *, use_kernels, warm_flash, kv_quant="none",
+         chunk=16, max_new=8, prefix_caching=False, resume=None):
+    rt = RuntimeConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                       prefill_chunk=chunk, prefill_max_batch=4,
+                       prefill_flash_warm=warm_flash, kv_quant=kv_quant,
+                       prefix_caching=prefix_caching)
+    sched = Scheduler(ServingEngine(model, params, rt,
+                                    use_kernels=use_kernels))
+    reqs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+    sched.run_until_done()
+    outs = [r.output for r in reqs]
+    if resume is not None:
+        # prefix-hit resume: a later request sharing a registered prefix
+        # admits warm (cached_at_admit > 0) and its FIRST chunk runs the
+        # warm path at start = cached
+        r = sched.submit(resume, max_new_tokens=max_new)
+        sched.run_until_done()
+        if prefix_caching:
+            assert r.cached_at_admit > 0
+        outs.append(r.output)
+    return outs
+
+
+def test_serving_warm_flash_vs_dense_parity():
+    """Chunked multi-request prefill through the scheduler: the flash
+    engine (fresh + warm kernels, merged gangs) must be token-identical
+    to the all-dense engine. Prompt lengths straddle chunk boundaries so
+    admission rounds mix warm continuations with fresh arrivals (ragged
+    starts) and odd gang widths pad (padding rows ride the null page)."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+    prompts = _prompts(0, (40, 23, 37))
+    dense = _run(model, params, prompts, use_kernels=False, warm_flash=False)
+    flash = _run(model, params, prompts, use_kernels=True, warm_flash=True)
+    assert dense == flash
+
+
+def test_serving_warm_flash_prefix_hit_resume_parity():
+    """Prefix-cache resume: the second request's first chunk starts warm
+    at the cached length; flash and dense engines agree token-for-token
+    and the hit actually happened."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(43))
+    shared = list(range(1, 17))          # two full 8-token pages
+    first = [shared + [5, 9]]
+    resume = shared + [7, 3, 2]
+    dense = _run(model, params, first, use_kernels=False, warm_flash=False,
+                 prefix_caching=True, resume=resume)
+    flash = _run(model, params, first, use_kernels=True, warm_flash=True,
+                 prefix_caching=True, resume=resume)
+    assert dense == flash
+
+
+@pytest.mark.parametrize("kv_quant,chunk", [("none", 8), ("int8", 8),
+                                            ("int8", 16)])
+def test_warm_flash_parity_grid(kv_quant, chunk):
+    """The acceptance grid: warm-flash vs dense byte-parity across cache
+    quantization x chunk size, with gangs of ragged lengths + a prefix-
+    hit resume leg (slow tier: several engine compiles)."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(44))
+    shared = list(range(1, 17))
+    prompts = [shared + p for p in _prompts(7, (9, 22))] + _prompts(8, (31,))
+    resume = shared + [11, 4]
+    kw = dict(kv_quant=kv_quant, chunk=chunk, prefix_caching=True,
+              resume=resume)
+    dense = _run(model, params, prompts, use_kernels=False,
+                 warm_flash=False, **kw)
+    flash = _run(model, params, prompts, use_kernels=True,
+                 warm_flash=True, **kw)
+    kernel_dense = _run(model, params, prompts, use_kernels=True,
+                        warm_flash=False, **kw)
+    assert dense == flash
+    assert dense == kernel_dense
+
+
+def test_engine_prefill_batch_ragged_starts_direct():
+    """Engine-level unit: ONE warm prefill_batch dispatch with ragged
+    starts (a carried warm member, a shorter warm member, a fresh
+    member) and an implicit padding row (B=3 buckets to 4). Last-token
+    logits must match the dense engine's bit-for-near-bit."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(45))
+    rt = RuntimeConfig(max_batch_size=4, max_seq_len=64, page_size=8)
+    rng = np.random.RandomState(3)
+    toks = [rng.randint(1, 250, (n,)).tolist() for n in (24, 8, 10)]
+    outs = {}
+    for use_k in (False, True):
+        eng = ServingEngine(model, params, rt, use_kernels=use_k)
+        # hand each slot a private page run (no allocator needed)
+        for slot in range(3):
+            eng.set_table_row(slot, list(range(slot * 8, slot * 8 + 8)))
+        # seed slots 0/1 with fresh context of different lengths
+        eng.prefill_batch([0, 1], [toks[0], toks[1]], [0, 0])
+        # ONE warm gang: starts 24 / 8 / 0 — ragged + a fresh row
+        logits = eng.prefill_batch([0, 1, 2], [[5, 9, 2], [7, 7], toks[2]],
+                                   [24, 8, 0])
+        outs[use_k] = np.asarray(logits)
+    np.testing.assert_allclose(outs[True], outs[False],
+                               rtol=3e-5, atol=3e-5)
+    assert (outs[True].argmax(-1) == outs[False].argmax(-1)).all()
+
+
+def test_contiguous_warm_flash_parity():
+    """models.common.forward warm multi-token chunk (the contiguous-
+    cache path: engine verify / chunk continuation) takes the kernel
+    under attn_impl=flash and matches dense, float and int8 caches."""
+    for quant in ("none", "int8"):
+        cfg_d = CFG
+        cfg_f = CFG.replace(attn_impl="flash")
+        model = Model(cfg_d)
+        params = model.init(jax.random.PRNGKey(1))
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 24), 1, 250)
+        outs = {}
+        for name, cfg in (("dense", cfg_d), ("flash", cfg_f)):
+            cache = init_cache(cfg, 2, 64, quant=quant)
+            _, cache = forward(params, cfg, toks[:, :10], cache, fresh=True)
+            l2, cache = forward(params, cfg, toks[:, 10:], cache)
+            outs[name] = np.asarray(l2)
+        np.testing.assert_allclose(outs["dense"], outs["flash"],
+                                   rtol=3e-5, atol=3e-5)
+        assert (outs["dense"].argmax(-1) == outs["flash"].argmax(-1)).all()
+
+
+# ---------------------------------------------------------------------------
+# Dispatch policy
+# ---------------------------------------------------------------------------
+
+
+def test_warm_flash_dispatches_kernel(monkeypatch):
+    """The warm program must actually take the kernel: count
+    flash_attention_sharded calls carrying a prefix segment from inside
+    the paged layer body. Flag off, warm dispatches must make none."""
+    import butterfly_tpu.cache.paged as paged
+
+    calls = {"prefix": 0, "fresh": 0}
+    real = paged.flash_attention_sharded
+
+    def spy(*args, **kw):
+        calls["prefix" if kw.get("prefix_k") is not None else "fresh"] += 1
+        return real(*args, **kw)
+
+    monkeypatch.setattr(paged, "flash_attention_sharded", spy)
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(46))
+    prompts = _prompts(9, (20,))
+    _run(model, params, prompts, use_kernels=True, warm_flash=True, chunk=8)
+    assert calls["prefix"] > 0 and calls["fresh"] > 0
+    calls.update(prefix=0, fresh=0)
+    _run(model, params, prompts, use_kernels=True, warm_flash=False, chunk=8)
+    assert calls["prefix"] == 0  # dense warm program never sees a prefix
+
+
+def test_gang_split_policy_properties():
+    """prefill_gang_split_fresh pins the bucketing rule: split ONLY with
+    prefill_flash_warm off (the seed behavior); warm_prefill_flash says
+    whether the warm program is actually kernelized (kernels AND flag)."""
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(47))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+    grid = [
+        # (use_kernels, flag) -> (warm_prefill_flash, split_fresh)
+        ((True, True), (True, False)),
+        ((True, False), (False, True)),
+        ((False, True), (False, False)),
+        ((False, False), (False, True)),
+    ]
+    for (use_k, flag), (want_flash, want_split) in grid:
+        eng = ServingEngine(model, params,
+                            rt.replace(prefill_flash_warm=flag),
+                            use_kernels=use_k)
+        assert eng.warm_prefill_flash == want_flash
+        assert eng.prefill_gang_split_fresh == want_split

@@ -175,6 +175,16 @@ MUTANTS = [
      "wlen = jnp.where(live, wlen + m, wlen)",
      "wlen = jnp.where(live, wlen + C, wlen)",
      ["tests/test_sched.py"], {}),
+    # warm-prefix flash prefill (ISSUE 13): drop the prefix-length mask
+    # — every row would attend the FULL cached-prefix block run,
+    # including recycled-buffer garbage past its start, zero padding,
+    # and (in serving) the chunk's own in-cache copy. Killed by the
+    # kernel unit's garbage-past-start bit-compare and the dense-insert
+    # parity checks in tests/test_warm_prefill.py.
+    ("butterfly_tpu/ops/flash_attention.py",
+     "mask = cols < start",
+     "mask = cols >= 0",
+     ["tests/test_warm_prefill.py"], {}),
     # workload generator: the Poisson arrival process ignores its rate
     # (every open-loop bench/sweep would silently offer ~1 req/s
     # regardless of the requested load) — the arrival-statistics test
