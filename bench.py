@@ -169,12 +169,20 @@ def main() -> int:
     serving.update(run_warm_prefill_benchmark(
         model, params, kv_quant=kv_quant, prompt_len=640,
         prefill_chunk=256, n_requests=6, max_batch=4))
+    # The spec phase also drafts with BOTH sources (ngram vs the real
+    # on-device draft model, ISSUE 14) on mixed_chat-shaped prompts at
+    # the same operating point: spec_accept_rate_model >
+    # spec_accept_rate_ngram is the ROADMAP item 3 evidence key.
+    # draft_layers=1: the tiny CPU model is 2 layers deep, so 1 is the
+    # only strict truncation; on the 8B a 1-layer shared-embed draft is
+    # the cheapest resident draft (the TPU operating point can raise it
+    # from the profile).
     spec_kw = dict(n_requests=serving_kw["n_requests"],
                    prompt_len=serving_kw["prompt_len"],
                    max_new=serving_kw["max_new"],
                    max_batch=serving_kw["max_batch"],
                    decode_steps_per_tick=serving_kw["decode_steps_per_tick"],
-                   gamma=4)
+                   gamma=4, draft_layers=1)
     serving.update(run_spec_benchmark(
         model, params, kv_quant=kv_quant, **spec_kw))
     # Mixed-workload phase (ISSUE 10): the canned mixed_chat population

@@ -52,6 +52,25 @@ def load_checkpoint(path: str, cfg: ModelConfig):
     return params_from_hf_state_dict(sd, cfg)
 
 
+def load_draft_checkpoint(path: str, target_cfg: ModelConfig):
+    """Independent narrow draft checkpoint for speculative serving
+    (`serve --draft-ckpt`, RuntimeConfig.draft_ckpt): an HF-format dir
+    whose config.json describes the draft's own (smaller) geometry.
+
+    The draft proposes tokens the TARGET verifies, so the vocabularies
+    must be the same object — a mismatch would silently score q(x)
+    against the wrong ids, biasing every accept test. Geometry is
+    otherwise free (narrower hidden, fewer layers, different head
+    counts). Returns (draft_cfg, draft_params)."""
+    dcfg = config_from_hf_dir(path)
+    if dcfg.vocab_size != target_cfg.vocab_size:
+        raise ValueError(
+            f"draft checkpoint vocab {dcfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}: the draft must propose in the "
+            f"target's vocabulary (same tokenizer)")
+    return dcfg, load_checkpoint(path, dcfg)
+
+
 def config_from_hf_dir(path: str) -> ModelConfig:
     """Best-effort ModelConfig from a HF config.json next to the weights."""
     cj = json.loads((Path(path) / "config.json").read_text())

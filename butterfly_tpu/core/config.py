@@ -270,15 +270,37 @@ class RuntimeConfig:
                                       # exact rejection-sampling
                                       # correction. 0 = off
     speculative_ngram: int = 2        # lookup ngram for the drafts
-    draft_model: str = "ngram"        # draft source for the spec block:
+    draft_model: str = "ngram"        # draft source for the spec block
+                                      # (engine.serving.DRAFT_SOURCES):
                                       # "ngram" = model-free prompt
                                       # lookup over the device-side
-                                      # token history; a small
-                                      # on-device draft model plugs in
-                                      # via engine.serving.
-                                      # register_draft_source (a jax
-                                      # callable traced inside the
-                                      # jitted spec scan)
+                                      # token history (free, but earns
+                                      # ~0 on non-repetitive traffic);
+                                      # "model" = a real on-device
+                                      # draft model (models/draft.py)
+                                      # whose per-round γ-step forward
+                                      # runs INSIDE the jitted spec
+                                      # scan, over its own
+                                      # rollback-exact KV cache riding
+                                      # the block carry. Custom sources
+                                      # plug in via
+                                      # register_draft_source
+    draft_layers: int = 0             # "model" source, derivation: use
+                                      # the first draft_layers layers
+                                      # of the TARGET checkpoint as the
+                                      # draft (embed/final-norm/unembed
+                                      # shared by reference — zero
+                                      # extra HBM for them; resident on
+                                      # the same chip). 0 = auto
+                                      # (num_layers/4, floored at 1).
+                                      # Ignored when draft_ckpt is set
+    draft_ckpt: Optional[str] = None  # "model" source, loading: an
+                                      # independent HF-format draft
+                                      # checkpoint (narrow config, SAME
+                                      # vocabulary — validated) loaded
+                                      # through the existing ckpt
+                                      # machinery instead of deriving
+                                      # by truncation
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

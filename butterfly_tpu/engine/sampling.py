@@ -43,10 +43,11 @@ def _filter_logits(scaled: jax.Array, top_k: int, top_p: float) -> jax.Array:
 
 def speculative_accept(logits: jax.Array, drafts: jax.Array, key: jax.Array,
                        temps: jax.Array, top_k: int, top_p: float,
-                       spec_mask: jax.Array = None):
+                       spec_mask: jax.Array = None,
+                       q_logits: jax.Array = None):
     """Batched draft acceptance with the rejection-sampling correction
-    (Leviathan et al. 2023), for DETERMINISTIC drafts (prompt-lookup /
-    greedy draft models — the proposal q is a point mass at the draft).
+    (Leviathan et al. 2023), for one-hot OR real proposal
+    distributions.
 
     logits [S, C, V] are a verify forward's per-position target logits
     (C = gamma + 1: position i is the next-token distribution after the
@@ -55,17 +56,34 @@ def speculative_accept(logits: jax.Array, drafts: jax.Array, key: jax.Array,
 
     Per position i the target distribution p_i is EXACTLY the one plain
     decode samples from (temperature-scaled, top-k/top-p filtered —
-    _filter_logits). With a one-hot proposal q, accept-with-prob
-    min(1, p/q) reduces to: accept draft d_i with probability p_i(d_i);
-    on the first rejection resample from the residual (p - q)+ — p_i
-    with d_i masked out, renormalized — and when every draft is
-    accepted, sample one bonus token from p_gamma. Total emitted per
-    slot: n_acc + 1 tokens whose joint law equals autoregressive
-    sampling from p — speculation changes how many forwards the tokens
-    take, never their distribution. Greedy rows (temp 0) take the
-    `_accept_drafts` fast path semantics instead: accept while
-    d_i == argmax_i, emit the argmax at the first mismatch — output
-    byte-identical to plain greedy decode.
+    _filter_logits). The proposal q_i:
+
+    * q_logits None — DETERMINISTIC drafts (prompt lookup / greedy
+      draft models): q is a point mass at the draft, and
+      accept-with-prob min(1, p/q) reduces to accepting d_i with
+      probability p_i(d_i); the first rejection resamples from the
+      residual p_i with d_i masked out, renormalized.
+    * q_logits [S, gamma, V] — REAL drafts (an on-device draft model,
+      models/draft.py): the proposal logits the drafts were actually
+      sampled from, ALREADY temperature-scaled and filtered exactly as
+      the drafter sampled (the draft source passes its own
+      _filter_logits output through, so p and q are scored on
+      consistent supports). The full Leviathan rule applies: accept
+      d_i w.p. min(1, p_i(d_i)/q_i(d_i)); the first rejection
+      resamples from the normalized residual (p_i - q_i)+. Wherever a
+      rejection can occur at all (p(d) < q(d)) the residual has mass
+      — tokens with p > q exist because both distributions sum to 1 —
+      so the degenerate empty-residual row is unreachable, the same
+      argument as the one-hot case below.
+
+    When every draft is accepted, one bonus token samples from
+    p_gamma. Total emitted per slot: n_acc + 1 tokens whose joint law
+    equals autoregressive sampling from p — speculation changes how
+    many forwards the tokens take, never their distribution. Greedy
+    rows (temp 0) take the `_accept_drafts` fast path semantics
+    instead regardless of q: accept while d_i == argmax_i, emit the
+    argmax at the first mismatch — output byte-identical to plain
+    greedy decode (the draft-model parity contract rides on this).
 
     p_i(d_i) == 1 (the draft is the whole filtered nucleus) always
     accepts (u ~ U[0,1) < 1), so the degenerate all--inf residual row
@@ -97,16 +115,33 @@ def speculative_accept(logits: jax.Array, drafts: jax.Array, key: jax.Array,
         p_draft = jnp.take_along_axis(
             probs, drafts[..., None].astype(jnp.int32), axis=-1)[..., 0]
         u = jax.random.uniform(ku, (S, gamma))
-        accept = jnp.where(stochastic[:, None], u < p_draft,
+        if q_logits is None:
+            # one-hot proposal: accept w.p. p(d), residual = p with the
+            # tested-and-rejected draft masked out
+            acc_p = p_draft
+            one_hot = jax.nn.one_hot(drafts, V, dtype=bool)
+            resid = jnp.where(one_hot & spec_mask[:, None, None], -jnp.inf,
+                              scaled[:, :gamma, :])
+        else:
+            # real proposal: accept w.p. min(1, p(d)/q(d)), residual =
+            # normalized (p - q)+ (categorical renormalizes for us).
+            # q(d) > 0 always — d was sampled from q — the guard only
+            # shields padding rows from 0/0
+            q_probs = jax.nn.softmax(q_logits, axis=-1)
+            q_draft = jnp.take_along_axis(
+                q_probs, drafts[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            acc_p = jnp.where(q_draft > 0, p_draft / q_draft, 1.0)
+            resid_p = jnp.maximum(probs - q_probs, 0.0)
+            resid = jnp.where(resid_p > 0, jnp.log(resid_p), -jnp.inf)
+            # opt-out rows never tested: their distribution stays full
+            resid = jnp.where(spec_mask[:, None, None], resid,
+                              scaled[:, :gamma, :])
+        accept = jnp.where(stochastic[:, None], u < acc_p,
                            drafts == greedy_tok[:, :gamma])
         accept = accept & spec_mask[:, None]
         n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
                         axis=1).astype(jnp.int32)
-        # residual: position i with the TESTED-and-rejected draft masked
-        # out; opt-out rows never tested, so their distribution stays full
-        one_hot = jax.nn.one_hot(drafts, V, dtype=bool)
-        resid = jnp.where(one_hot & spec_mask[:, None, None], -jnp.inf,
-                          scaled[:, :gamma, :])
         corr_logits = jnp.concatenate([resid, scaled[:, gamma:, :]], axis=1)
         pad_drafts = jnp.concatenate(
             [drafts.astype(jnp.int32), jnp.zeros((S, 1), jnp.int32)], axis=1)

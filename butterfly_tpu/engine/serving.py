@@ -124,19 +124,97 @@ def _ngram_drafts(hist, hist_len, gamma: int, ngram: int) -> jax.Array:
     return jnp.where(valid, cont, 0).astype(jnp.int32)
 
 
+class _FnDraftSource:
+    """Adapter giving a plain draft FUNCTION (the PR 9 contract:
+    (hist [S, H], hist_len [S], gamma, ngram) -> drafts [S, gamma]
+    int32, pure jax) the full draft-source interface. Stateless: no KV,
+    no proposal distribution (q one-hot at the draft — the accept test
+    reduces to u < p(d))."""
+
+    stateful = False
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def init_state(self):
+        return None
+
+    def draft(self, hist, hlen, gamma, ngram, live, state, key, temps,
+              top_k, top_p):
+        return self.fn(hist, hlen, gamma, ngram), None, None
+
+
+def _build_model_draft_source(engine: "ServingEngine"):
+    """DRAFT_SOURCES["model"] factory: a real on-device draft model
+    (models/draft.py) — an independent narrow checkpoint when
+    RuntimeConfig.draft_ckpt is set, else the truncated-layer
+    derivation of the engine's own params (first draft_layers layers,
+    shared embed/unembed — already cast/quantized/sharded exactly like
+    the target, since the leaves ARE the target's)."""
+    from butterfly_tpu.models.draft import (
+        ModelDraftSource, derive_draft_params)
+    rt = engine.runtime
+    if rt.draft_ckpt:
+        from butterfly_tpu.ckpt.load import load_draft_checkpoint
+        from butterfly_tpu.engine.engine import cast_params
+        dcfg, dparams = load_draft_checkpoint(rt.draft_ckpt, engine.cfg)
+        dparams = cast_params(dparams, dcfg)
+    else:
+        dcfg, dparams = derive_draft_params(engine.params, engine.cfg,
+                                            rt.draft_layers)
+    # width = serving max_seq + γ+1 slack: micro-step writes at the
+    # sequence cap must clamp into slack, never onto a live entry
+    return ModelDraftSource(
+        dcfg, dparams, num_slots=engine.num_slots,
+        width=engine.cache.max_seq + rt.speculative_gamma + 1,
+        kv_quant=rt.kv_quant)
+
+
+_build_model_draft_source.draft_source_factory = True
+
+
 #: Draft-source registry for the serving spec block
-#: (RuntimeConfig.draft_model selects by name). A source is a pure jax
-#: callable (hist [S, H], hist_len [S], gamma, ngram) -> drafts
-#: [S, gamma] int32, traced INSIDE the jitted spec scan — a small
-#: on-device draft model registers a closure over its own params here
-#: (its whole gamma-step greedy decode then fuses into the verify
-#: program). "ngram" is the model-free prompt-lookup default.
-DRAFT_SOURCES: Dict[str, object] = {"ngram": _ngram_drafts}
+#: (RuntimeConfig.draft_model selects by name). An entry is either
+#: * a pure jax callable (hist [S, H], hist_len [S], gamma, ngram) ->
+#:   drafts [S, gamma] int32, traced INSIDE the jitted spec scan (the
+#:   PR 9 contract — "ngram" is the model-free prompt-lookup default);
+#: * or a FACTORY (attribute draft_source_factory=True) called with
+#:   the engine at build time, returning a source object with
+#:   `.stateful`, `.init_state()`, `.draft(hist, hlen, gamma, ngram,
+#:   live, state, key, temps, top_k, top_p) -> (drafts, q_logits,
+#:   state)` (pure jax, traced in-scan) and — when stateful —
+#:   `.prefill(state, slots, rows, lens)` (the host-side admission
+#:   reseed hook). "model" is the on-device draft model
+#:   (models/draft.py): its per-round γ-step forward fuses into the
+#:   verify program, its KV cache rides the block carry with exact
+#:   rollback, and its real proposal distribution q(x) feeds the full
+#:   Leviathan accept rule.
+DRAFT_SOURCES: Dict[str, object] = {
+    "ngram": _ngram_drafts,
+    "model": _build_model_draft_source,
+}
 
 
 def register_draft_source(name: str, fn) -> None:
-    """Register a custom draft source (see DRAFT_SOURCES contract)."""
+    """Register a custom draft source (see DRAFT_SOURCES contract:
+    plain draft fn, or factory marked draft_source_factory=True)."""
     DRAFT_SOURCES[name] = fn
+
+
+def _draft_rollback(dstate, dlen0, live, m):
+    """Roll the draft-model KV length back to the ACCEPTED count: the
+    γ+1 micro-steps advanced a live slot's draft cache to dlen0 + γ+1;
+    only the m accepted emissions stay live — rejected drafts' K/V sit
+    past the rolled-back length, unattendable (the draft attends
+    strictly below its length), and the next round's micro-steps
+    overwrite them in place starting exactly at dlen0 + m. This is the
+    draft-side twin of _spec_scan's cache-length rollback and the
+    windowed path's win_len advance — exact by construction, no stale
+    draft state ever influences a later proposal. No-op (None) for
+    stateless sources."""
+    if dstate is None:
+        return None
+    return dstate._replace(length=jnp.where(live, dlen0 + m, dlen0))
 
 
 class ServingEngine:
@@ -247,15 +325,30 @@ class ServingEngine:
         # Fused speculative blocks (scheduler speculative mode): one
         # jitted program per round count, like _decode_blocks. The
         # draft source resolves from runtime.draft_model NOW so a typo
-        # fails at engine build, not at the first spec dispatch.
+        # fails at engine build, not at the first spec dispatch; the
+        # "model" source also builds its draft weights (truncation or
+        # --draft-ckpt) and allocates its KV carry here.
         self._spec_blocks: Dict[int, object] = {}
+        self._draft_stateful = False
+        self._draft_state = None
         if self.runtime.speculative_gamma > 0:
             name = self.runtime.draft_model
             if name not in DRAFT_SOURCES:
                 raise ValueError(
                     f"unknown draft source {name!r}: expected one of "
                     f"{sorted(DRAFT_SOURCES)} (register_draft_source)")
-            self._draft_fn = DRAFT_SOURCES[name]
+            entry = DRAFT_SOURCES[name]
+            if getattr(entry, "draft_source_factory", False):
+                self._draft_src = entry(self)
+            elif hasattr(entry, "draft"):
+                self._draft_src = entry        # pre-built source object
+            else:
+                self._draft_src = _FnDraftSource(entry)
+            self._draft_stateful = bool(
+                getattr(self._draft_src, "stateful", False))
+            if self._draft_stateful:
+                with self._mesh_ctx():
+                    self._draft_state = self._draft_src.init_state()
 
     def _mesh_ctx(self):
         import contextlib
@@ -615,30 +708,50 @@ class ServingEngine:
                 k_pages=kp, v_pages=vp,
                 k_scale_pages=ksp, v_scale_pages=vsp)
 
+    def draft_prefill(self, slots, rows, lens) -> None:
+        """Reseed newly admitted slots' draft-model KV cache from host
+        truth (the scheduler calls this from _finish_prefill with the
+        same prompt rows it seeds the token-history carry with — the
+        first sampled token excluded, which is exactly the
+        draft_len == hist_len - 1 invariant the in-scan micro-steps
+        maintain). Runs only under a stateful ("model") draft source;
+        admission happens behind a full drain barrier, so no spec
+        block is in flight against the donated draft state."""
+        if not self._draft_stateful:
+            return
+        with self._mesh_ctx():
+            self._draft_state = self._draft_src.prefill(
+                self._draft_state, slots, rows, lens)
+
     def _spec_block_prog(self, rounds: int):
         prog = self._spec_blocks.get(rounds)
         if prog is None:
             rt = self.runtime
+            # the draft state (arg 4) joins the donation set only when
+            # the source carries one (the "model" draft KV cache)
+            dn = (1, 3, 4) if self._draft_stateful else (1, 3)
             prog = jax.jit(
                 partial(_spec_scan, self.cfg, self._fwd, rounds,
                         rt.speculative_gamma, rt.speculative_ngram,
-                        self._draft_fn, use_kernel=self._use_kernels),
-                static_argnums=(8, 9), donate_argnums=(1, 3))
+                        self._draft_src, use_kernel=self._use_kernels),
+                static_argnums=(9, 10), donate_argnums=dn)
             self._spec_blocks[rounds] = prog
         return prog
 
     def _spec_block_win_prog(self, rounds: int):
         """Windowed twin of _spec_block_prog: donates the history carry
         (like the plain spec block) plus the cache / window / staged
-        count triple (like the windowed decode block)."""
+        count triple (like the windowed decode block), plus the draft
+        state under a stateful source."""
         prog = self._spec_win_blocks.get(rounds)
         if prog is None:
             rt = self.runtime
+            dn = (1, 3, 4, 5, 6) if self._draft_stateful else (1, 3, 5, 6)
             prog = jax.jit(
                 partial(_spec_scan_win, self.cfg, rounds,
                         rt.speculative_gamma, rt.speculative_ngram,
-                        self._draft_fn, use_kernel=self._use_kernels),
-                static_argnums=(10, 11), donate_argnums=(1, 3, 4, 5))
+                        self._draft_src, use_kernel=self._use_kernels),
+                static_argnums=(11, 12), donate_argnums=dn)
             self._spec_win_blocks[rounds] = prog
         return prog
 
@@ -667,16 +780,21 @@ class ServingEngine:
         window and only win_len advances by the ACCEPTED count per
         round — rejected drafts' K/V sit past win_len, unattendable,
         and are never flushed into the pool (exact rollback by
-        construction)."""
+        construction).
+
+        Under a stateful draft source ("model") the draft KV cache
+        rides the same carry: donated in, advanced per round by the
+        accepted count only (_draft_rollback), rebound here."""
         self._sync_table()
         if self._window_mode:
             C = self.runtime.speculative_gamma + 1
             self._ensure_window(rounds * C)
             with self._mesh_ctx():
-                toks, valid, hist, hist_len, rem, cache, window, wlen = \
-                    self._spec_block_win_prog(rounds)(
+                (toks, valid, hist, hist_len, rem, cache, window, wlen,
+                 dstate) = self._spec_block_win_prog(rounds)(
                         self.params, hist,
                         jnp.asarray(hist_len, jnp.int32), self.cache,
+                        self._draft_state,
                         self._kv_window, self._win_len,
                         jnp.asarray(active, bool), jnp.asarray(temps),
                         jnp.asarray(stops, jnp.int32),
@@ -684,19 +802,21 @@ class ServingEngine:
                         self.runtime_top_k, self.runtime_top_p, key,
                         jnp.asarray(spec_mask, bool))
             self.cache, self._kv_window, self._win_len = cache, window, wlen
+            self._draft_state = dstate
             self._win_dirty = True
             self._win_hwm += rounds * C
             return toks, valid, hist, hist_len, rem
         with self._mesh_ctx():
-            toks, valid, hist, hist_len, rem, cache = \
+            toks, valid, hist, hist_len, rem, cache, dstate = \
                 self._spec_block_prog(rounds)(
                     self.params, hist, jnp.asarray(hist_len, jnp.int32),
-                    self.cache, jnp.asarray(active, bool),
+                    self.cache, self._draft_state,
+                    jnp.asarray(active, bool),
                     jnp.asarray(temps), jnp.asarray(stops, jnp.int32),
                     jnp.asarray(budgets, jnp.int32),
                     self.runtime_top_k, self.runtime_top_p, key,
                     jnp.asarray(spec_mask, bool))
-        self.cache = cache
+        self.cache, self._draft_state = cache, dstate
         return toks, valid, hist, hist_len, rem
 
     # static sampling knobs (per-slot temps are dynamic)
@@ -834,26 +954,32 @@ def _decode_scan_win(cfg: ModelConfig, k: int, params, tokens,
 
 
 def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
-               draft_fn, params, hist, hist_len, cache: PagedKVCache,
-               active, temps, stops, budgets, top_k: int, top_p: float,
-               key, spec_mask, use_kernel: bool = False):
+               draft_src, params, hist, hist_len, cache: PagedKVCache,
+               dstate, active, temps, stops, budgets, top_k: int,
+               top_p: float, key, spec_mask, use_kernel: bool = False):
     """`rounds` chained speculative rounds in ONE lax.scan — the
     speculative twin of _decode_scan, emitting 1..gamma+1 tokens per
     live slot per round instead of exactly one.
 
-    Each round, for every live slot at once: (1) draft gamma tokens
-    from the device-resident history (`draft_fn` — prompt lookup by
-    default, or a registered draft model); (2) run ONE batched
+    Each round, for every live slot at once: (1) draft gamma tokens —
+    prompt lookup over the device-resident history, or a real
+    on-device draft model (`draft_src.draft`, models/draft.py) whose γ
+    micro-steps run over its own KV carry `dstate` and return the
+    proposal distribution q alongside the tokens; (2) run ONE batched
     (gamma+1)-token verify forward over [S, C] chunks (the dense warm
     multi-token path — the same program shape as a chunked warm
     prefill), writing ALL positions' K/V; (3) accept/correct ON DEVICE
     (sampling.speculative_accept: rejection-sampling correction at
-    temperature > 0, `_accept_drafts` greedy semantics at 0);
+    temperature > 0 — the full min(1, p/q) rule under a real q —
+    `_accept_drafts` greedy semantics at 0);
     (4) truncate the emitted run at the slot's stop id / remaining
     budget, roll the slot's cache length back to its written-token
-    count, and append the survivors to the history carry. No host
-    round-trip decides acceptance — the host drains stacked
-    (tokens, validity) blocks after the fact, exactly like decode.
+    count, roll the DRAFT cache length back to the accepted count
+    (_draft_rollback — rejected drafts' K/V become unattendable and
+    are overwritten in place next round), and append the survivors to
+    the history carry. No host round-trip decides acceptance — the
+    host drains stacked (tokens, validity) blocks after the fact,
+    exactly like decode.
 
     KV correctness under rejection is the write-then-attend argument
     (engine.generate_speculative docs): rejected positions hold stale
@@ -871,8 +997,8 @@ def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
     starts it dead too.
 
     Returns (toks [rounds, S, C], valid [rounds, S, C], hist,
-    hist_len, rem, cache) — valid[r, s, c] marks toks[r, s, c] as a
-    real emission of round r (in (round, position) order).
+    hist_len, rem, cache, dstate) — valid[r, s, c] marks toks[r, s, c]
+    as a real emission of round r (in (round, position) order).
     """
     S, H = hist.shape
     C = gamma + 1
@@ -885,8 +1011,14 @@ def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
         & jnp.where(has_stop, last0 != stops, True)
 
     def body(carry, i):
-        hist, hlen, cache, live, rem = carry
-        drafts = draft_fn(hist, hlen, gamma, ngram)
+        hist, hlen, cache, dst, live, rem = carry
+        dlen0 = dst.length if dst is not None else None
+        # per-round draft key (stochastic draft-model proposals): the
+        # fold_in index offsets past the accept keys' 0..rounds-1 range
+        # so the two streams never collide within a block
+        drafts, qlog, dst = draft_src.draft(
+            hist, hlen, gamma, ngram, live, dst,
+            jax.random.fold_in(key, rounds + i), temps, top_k, top_p)
         last = jnp.take_along_axis(
             hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
         toks = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, C]
@@ -898,7 +1030,7 @@ def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
                             use_kernel=use_kernel)
         emitted, n_acc = speculative_accept(
             logits, drafts, jax.random.fold_in(key, i), temps,
-            top_k, top_p, spec_mask)
+            top_k, top_p, spec_mask, qlog)
         # emitted prefix n_acc+1, clipped at the remaining budget, cut
         # at the first stop id INCLUSIVE (the stop token itself emits,
         # like _emit's host truncation)
@@ -910,8 +1042,10 @@ def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
         m = valid.sum(axis=1).astype(jnp.int32)
         # written tokens are the old chain token + the accepted drafts:
         # roll the verify's +C advance back to W + m (the last emitted
-        # token — correction/bonus — is never written, decode-style)
+        # token — correction/bonus — is never written, decode-style);
+        # the draft cache rolls back by the same rule
         cache = cache._replace(lengths=jnp.where(live, W + m, W))
+        dst = _draft_rollback(dst, dlen0, live, m)
         wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
         cur = jnp.take_along_axis(hist, wpos, axis=1)
         hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
@@ -920,19 +1054,19 @@ def _spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int, ngram: int,
         died = (valid & has_stop[:, None]
                 & (emitted == stops[:, None])).any(axis=1)
         live = live & ~died & (rem > 0)
-        return (hist, hlen, cache, live, rem), (emitted, valid)
+        return (hist, hlen, cache, dst, live, rem), (emitted, valid)
 
-    (hist, hist_len, cache, _, rem), (toks_blk, valid_blk) = lax.scan(
-        body, (hist, hist_len, cache, live0, budgets),
-        jnp.arange(rounds, dtype=jnp.int32))
-    return toks_blk, valid_blk, hist, hist_len, rem, cache
+    (hist, hist_len, cache, dstate, _, rem), (toks_blk, valid_blk) = \
+        lax.scan(body, (hist, hist_len, cache, dstate, live0, budgets),
+                 jnp.arange(rounds, dtype=jnp.int32))
+    return toks_blk, valid_blk, hist, hist_len, rem, cache, dstate
 
 
 def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
-                   draft_fn, params, hist, hist_len, cache: PagedKVCache,
-                   window: KVWindow, win_len, active, temps, stops,
-                   budgets, top_k: int, top_p: float, key, spec_mask,
-                   use_kernel: bool = False):
+                   draft_src, params, hist, hist_len, cache: PagedKVCache,
+                   dstate, window: KVWindow, win_len, active, temps,
+                   stops, budgets, top_k: int, top_p: float, key,
+                   spec_mask, use_kernel: bool = False):
     """Write-combined twin of _spec_scan — draft/verify/accept semantics
     are IDENTICAL (the spec parity grid pins byte-equality); only the
     K/V write target differs. Each round's verify stages ALL C = gamma+1
@@ -945,10 +1079,12 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
     holds stale speculative state (window-off relies on the
     write-then-attend rewrite argument for those positions). The next
     round's C-wide write at the new win_len overwrites the stale run
-    inside the window buffer itself.
+    inside the window buffer itself. The draft-model KV carry `dstate`
+    follows the exact same per-round accepted-count rollback as the
+    plain scan (_draft_rollback).
 
     Returns (toks [rounds, S, C], valid [rounds, S, C], hist, hist_len,
-    rem, cache, window, win_len).
+    rem, cache, window, win_len, dstate).
     """
     S, H = hist.shape
     C = gamma + 1
@@ -961,8 +1097,11 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
         & jnp.where(has_stop, last0 != stops, True)
 
     def body(carry, i):
-        hist, hlen, win, wlen, live, rem = carry
-        drafts = draft_fn(hist, hlen, gamma, ngram)
+        hist, hlen, win, wlen, dst, live, rem = carry
+        dlen0 = dst.length if dst is not None else None
+        drafts, qlog, dst = draft_src.draft(
+            hist, hlen, gamma, ngram, live, dst,
+            jax.random.fold_in(key, rounds + i), temps, top_k, top_p)
         last = jnp.take_along_axis(
             hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
         toks = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, C]
@@ -971,7 +1110,7 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
                                            use_kernel=use_kernel)
         emitted, n_acc = speculative_accept(
             logits, drafts, jax.random.fold_in(key, i), temps,
-            top_k, top_p, spec_mask)
+            top_k, top_p, spec_mask, qlog)
         # emitted prefix n_acc+1, clipped at the remaining budget, cut
         # at the first stop id INCLUSIVE — byte-for-byte _spec_scan's
         # truncation
@@ -983,8 +1122,10 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
         m = valid.sum(axis=1).astype(jnp.int32)
         # keep the old chain token + the accepted drafts staged; the
         # last emitted token (correction/bonus) is never staged,
-        # decode-style — win_len is the rollback
+        # decode-style — win_len is the rollback; the draft cache
+        # rolls back by the same accepted count
         wlen = jnp.where(live, wlen + m, wlen)
+        dst = _draft_rollback(dst, dlen0, live, m)
         wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
         cur = jnp.take_along_axis(hist, wpos, axis=1)
         hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
@@ -993,9 +1134,12 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
         died = (valid & has_stop[:, None]
                 & (emitted == stops[:, None])).any(axis=1)
         live = live & ~died & (rem > 0)
-        return (hist, hlen, win, wlen, live, rem), (emitted, valid)
+        return (hist, hlen, win, wlen, dst, live, rem), (emitted, valid)
 
-    (hist, hist_len, window, win_len, _, rem), (toks_blk, valid_blk) = \
-        lax.scan(body, (hist, hist_len, window, win_len, live0, budgets),
-                 jnp.arange(rounds, dtype=jnp.int32))
-    return toks_blk, valid_blk, hist, hist_len, rem, cache, window, win_len
+    (hist, hist_len, window, win_len, dstate, _, rem), \
+        (toks_blk, valid_blk) = lax.scan(
+            body, (hist, hist_len, window, win_len, dstate, live0,
+                   budgets),
+            jnp.arange(rounds, dtype=jnp.int32))
+    return (toks_blk, valid_blk, hist, hist_len, rem, cache, window,
+            win_len, dstate)

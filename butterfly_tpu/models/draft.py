@@ -1,0 +1,235 @@
+"""On-device draft model for speculative serving (ROADMAP item 3).
+
+The PR 9 spec block drafted from prompt-lookup n-grams only — a
+model-free source that earns nothing on non-repetitive traffic. This
+module supplies the real thing: a small same-architecture draft model,
+resident on the same chip, whose per-round forward runs INSIDE the
+jitted spec scan (engine/serving.py `_spec_scan`) so drafting never
+costs a host trip.
+
+Two ways to get draft weights:
+
+* **Truncated-layer derivation** (`derive_draft_params`): the first
+  `draft_layers` layers of the target checkpoint, with the embedding,
+  final norm, and unembedding SHARED by reference (same device buffers
+  — zero extra HBM for them). Residual-stream architectures make this
+  a surprisingly strong free draft: the hidden state after L_d layers
+  already points near the full model's output direction, and the
+  shared unembed reads it out in the target's own vocabulary geometry
+  (the self-speculative family — PAPERS.md arXiv:2305.09781 builds on
+  exactly this kind of cheap draft before token trees).
+* **Independent narrow checkpoint** (`--draft-ckpt`,
+  ckpt.load.load_draft_checkpoint): any HF-format model with the SAME
+  vocabulary, loaded through the existing ckpt machinery.
+
+The draft keeps its own KV cache — a contiguous
+[L_d, S, W_d, Kv_d, H_d] buffer (models.common.KVCache, so it is the
+pool representation already: int8 codes + per-vector scales when
+RuntimeConfig.kv_quant="int8") that RIDES THE SPEC BLOCK CARRY. Each
+round the γ+1 draft micro-steps write their K/V at the draft length;
+after the verify, the length advances by the ACCEPTED count only
+(engine/serving.py `_draft_rollback`), so a rejected draft's K/V sits
+past the live length — unattendable, and overwritten in place by the
+next round's micro-steps, which start exactly at the rolled-back
+length. Rollback is exact BY CONSTRUCTION, the same argument as the
+PR 12 window's win_len. At every admission the scheduler reseeds the
+slot's draft KV from host truth (`ServingEngine.draft_prefill` — one
+small batched fresh forward over the gang's prompts), exactly like the
+PR 9 history carry, so preemption/readmission can never leave stale
+draft state behind.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from butterfly_tpu.core.config import ModelConfig
+from butterfly_tpu.engine.sampling import _filter_logits
+from butterfly_tpu.models.common import KVCache, forward, init_cache
+
+Params = dict
+
+
+def resolve_draft_layers(cfg: ModelConfig, draft_layers: int) -> int:
+    """Validated truncation depth: `draft_layers` as given, or (when 0,
+    the config default) a quarter of the target's depth, floored at 1.
+    Must leave the derivation a strict truncation — a draft as deep as
+    the target would just run the target twice."""
+    if draft_layers < 0:
+        raise ValueError(f"draft_layers must be >= 0, got {draft_layers}")
+    n = draft_layers if draft_layers > 0 else max(1, cfg.num_layers // 4)
+    if not 1 <= n < cfg.num_layers:
+        raise ValueError(
+            f"draft_layers={draft_layers} invalid for a "
+            f"{cfg.num_layers}-layer target: need 1 <= n < num_layers")
+    return n
+
+
+def derive_draft_params(params: Params, cfg: ModelConfig,
+                        draft_layers: int) -> Tuple[ModelConfig, Params]:
+    """Truncated-layer draft derivation: first `draft_layers` layers of
+    the target tree, shared embed/final-norm/unembed.
+
+    Layer-stacked leaves ([L, ...], including quantized {w, scale}
+    dicts — every inner array keeps L leading) are sliced
+    `[:draft_layers]`; the embedding table, final norm, and LM head are
+    the SAME array objects as the target's (no copy, no extra HBM —
+    the round-trip test pins identity). Works on float, cast, and int8
+    weight trees alike because slicing is dtype-agnostic.
+    """
+    n = resolve_draft_layers(cfg, draft_layers)
+    dcfg = cfg.replace(num_layers=n)
+    dparams: Params = {
+        "embed": params["embed"],                      # shared, by ref
+        "layers": jax.tree.map(lambda a: a[:n], params["layers"]),
+        "final_norm": params["final_norm"],            # shared, by ref
+    }
+    if "lm_head" in params:
+        dparams["lm_head"] = params["lm_head"]         # shared, by ref
+    return dcfg, dparams
+
+
+def _pow2(n: int, lo: int, hi: int) -> int:
+    """Next power-of-two bucket >= n in [lo, hi] (static-shape cap on
+    how many draft-prefill programs ever compile)."""
+    b = lo
+    while b < min(n, hi):
+        b *= 2
+    return min(b, hi)
+
+
+def _draft_prefill_step(cfg: ModelConfig, params, cache: KVCache,
+                        tokens, lens, slots):
+    """Seed `slots`' draft KV with their prompts: gather the member
+    rows' cache slices, run ONE fresh causal forward over the padded
+    [M, T] prompt chunk, scatter back. Padding rows carry an
+    out-of-range slot id: their gather clamps (reads garbage, unused)
+    and their scatter drops (mode="drop"), so they never touch live
+    state. Pad positions >= lens write K/V past the seeded length —
+    unattendable until the first micro-step overwrites them."""
+    quant = cache.quantized
+    sub = KVCache(
+        k=cache.k[:, slots], v=cache.v[:, slots],
+        length=jnp.zeros_like(lens),
+        k_scale=cache.k_scale[:, slots] if quant else None,
+        v_scale=cache.v_scale[:, slots] if quant else None)
+    T = tokens.shape[1]
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], tokens.shape)
+    _, sub = forward(params, cfg, tokens, sub, positions, fresh=True)
+    k = cache.k.at[:, slots].set(sub.k, mode="drop")
+    v = cache.v.at[:, slots].set(sub.v, mode="drop")
+    ks, vs = cache.k_scale, cache.v_scale
+    if quant:
+        ks = ks.at[:, slots].set(sub.k_scale, mode="drop")
+        vs = vs.at[:, slots].set(sub.v_scale, mode="drop")
+    length = cache.length.at[slots].set(lens, mode="drop")
+    return KVCache(k, v, length, ks, vs)
+
+
+class ModelDraftSource:
+    """Draft source backed by a real on-device model (DRAFT_SOURCES
+    entry "model", engine/serving.py).
+
+    State is the draft KVCache; `draft()` is pure jax traced inside the
+    spec scan (γ autoregressive micro-steps over the draft cache,
+    returning the drafted tokens AND their proposal logits so
+    `sampling.speculative_accept` can apply the full min(1, p/q)
+    rejection-sampling rule instead of the one-hot special case);
+    `prefill()` is the host-side admission hook.
+    """
+
+    stateful = True
+
+    def __init__(self, cfg: ModelConfig, params, *, num_slots: int,
+                 width: int, kv_quant: str = "none"):
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.width = width
+        self.kv_quant = kv_quant
+        self._prefill_prog = jax.jit(
+            partial(_draft_prefill_step, cfg), donate_argnums=(1,))
+
+    def init_state(self) -> KVCache:
+        """Fresh draft cache: [L_d, S, W_d, Kv_d, H_d] in the pool
+        representation (int8 codes + scales iff kv_quant="int8").
+        W_d = the serving cache's max_seq plus γ+1 slack so micro-step
+        writes can never clamp onto a live entry at the sequence cap."""
+        return init_cache(self.cfg, self.num_slots, self.width,
+                          quant=self.kv_quant)
+
+    def prefill(self, state: KVCache, slots: np.ndarray, rows: np.ndarray,
+                lens: np.ndarray) -> KVCache:
+        """Reseed newly admitted slots' draft KV from host truth (the
+        same rows the scheduler seeds the token-history carry with —
+        prompt + prior output on readmission, WITHOUT the first sampled
+        token, which is exactly the d_len = hist_len - 1 invariant:
+        the newest token's K/V is the next micro-step's write). Called
+        at a full drain barrier only (admission), so no spec block is
+        in flight against the donated state."""
+        M = len(slots)
+        T = _pow2(int(max(1, lens.max())), 16, self.width)
+        Mb = _pow2(M, 1, self.num_slots)
+        buf = np.zeros((Mb, T), np.int32)
+        buf[:M] = rows[:, :T]
+        lv = np.zeros((Mb,), np.int32)
+        lv[:M] = np.minimum(lens, T)
+        # padding rows scatter nowhere: out-of-range slot id + drop mode
+        sv = np.full((Mb,), self.num_slots, np.int32)
+        sv[:M] = slots
+        return self._prefill_prog(self.params, state, jnp.asarray(buf),
+                                  jnp.asarray(lv), jnp.asarray(sv))
+
+    def draft(self, hist, hlen, gamma: int, ngram: int, live, state,
+              key, temps, top_k: int, top_p: float):
+        """γ autoregressive micro-steps over the draft cache — pure
+        jax, traced inside the spec scan. Entry invariant:
+        state.length == hlen - 1 per live slot (every history token's
+        K/V except the newest is in the draft cache). Micro-step j
+        consumes the current token (the history tail first, then the
+        previous draft), writes its K/V at the draft length, and
+        proposes the next token — greedy for temp-0 slots, sampled
+        from the SAME temperature/top-k/top-p-filtered distribution
+        the accept test scores as q otherwise. A final (γ+1)-th step
+        writes the last draft's K/V without proposing, covering the
+        all-accepted case; the caller's rollback then lands the length
+        anywhere in [hlen-1+1, hlen-1+γ+1] without a gap. Dead slots'
+        lengths never advance — their (garbage) writes sit at the
+        frozen length, past the live region.
+
+        Returns (drafts [S, γ] int32, q_logits [S, γ, V] — the
+        filtered scaled proposal logits speculative_accept consumes —
+        and the advanced state, length = base + γ + 1 where live; the
+        spec scan rolls it back to base + accepted)."""
+        S, H = hist.shape
+        dlen0 = state.length
+        cur = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        drafts, qlogs = [], []
+        for j in range(gamma + 1):
+            logits, state = forward(self.params, self.cfg, cur[:, None],
+                                    state)
+            # forward advances every row; dead slots stay frozen (their
+            # write landed AT the frozen length — garbage past the live
+            # region, overwritten by the next live micro-step there)
+            state = state._replace(
+                length=jnp.where(live, dlen0 + j + 1, dlen0))
+            if j == gamma:
+                break
+            q = logits[:, -1, :]
+            scaled = _filter_logits(q / safe_t, top_k, top_p)
+            greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+            drawn = jax.random.categorical(
+                jax.random.fold_in(key, j), scaled, axis=-1
+            ).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, drawn, greedy)
+            drafts.append(nxt)
+            qlogs.append(scaled)
+            cur = nxt
+        return (jnp.stack(drafts, axis=1),
+                jnp.stack(qlogs, axis=1), state)

@@ -384,19 +384,29 @@ def run_spec_benchmark(model, params, *, n_requests: int = 8,
                        max_batch: int = 4, gamma: int = 4, ngram: int = 2,
                        decode_steps_per_tick: int = 4,
                        inflight_blocks: int = 2,
-                       kv_quant: str = "none", seed: int = 0) -> Dict:
+                       kv_quant: str = "none", seed: int = 0,
+                       draft_layers: int = 1) -> Dict:
     """Speculation phase of the serving bench: spec-on vs spec-off
     tokens/sec at the SAME operating point, plus the speculation
     instruments (spec_tokens_per_forward, spec_accept_rate) and the
     no-per-round-barrier property (drain barriers per verify round).
 
-    The workload is deliberately draft-friendly: each prompt is seeded
-    with the model's OWN greedy continuation (measured once up front),
-    so prompt-lookup drafts actually land — random prompts would
-    measure the correction's overhead, not speculation (the accept
-    rate rides the JSON either way, so the number stays honest).
-    Batched saturated drain at `max_batch` slots, greedy (the
-    byte-parity regime the serving tests pin)."""
+    The on/off workload is deliberately draft-friendly: each prompt is
+    seeded with the model's OWN greedy continuation (measured once up
+    front), so prompt-lookup drafts actually land — random prompts
+    would measure the correction's overhead, not speculation (the
+    accept rate rides the JSON either way, so the number stays
+    honest). Batched saturated drain at `max_batch` slots, greedy (the
+    byte-parity regime the serving tests pin).
+
+    A second sub-phase drafts with BOTH sources — "ngram" and the real
+    on-device draft model ("model", truncated at `draft_layers`) — on
+    mixed_chat-shaped prompts (the ROADMAP item 3 evidence shape:
+    realistic non-self-continuation traffic, where prompt lookup earns
+    little) at the same operating point, recording per-source
+    `spec_accept_rate_{ngram,model}` and
+    `spec_tokens_per_forward_{ngram,model}`. The acceptance criterion
+    is spec_accept_rate_model > spec_accept_rate_ngram."""
     import jax
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine.serving import ServingEngine
@@ -470,6 +480,42 @@ def run_spec_benchmark(model, params, *, n_requests: int = 8,
                                    / out["serving_spec_off_tokens_per_sec"]
                                    if out["serving_spec_off_tokens_per_sec"]
                                    else 0.0)
+
+    # draft-source comparison on mixed_chat-shaped prompts (ISSUE 14):
+    # ngram vs the real on-device draft model at the same operating
+    # point, greedy. mixed_chat prompts are template + fresh-tail
+    # cohorts — the realistic shape where prompt lookup earns little
+    # and a model draft has to carry the accept rate.
+    from butterfly_tpu.workload.models import mixed_chat
+    p_hi = max(16, prompt_len)
+    wl = mixed_chat(page_size=rt_off.page_size, vocab=V,
+                    prompt_lo=max(8, p_hi // 4), prompt_hi=p_hi,
+                    max_new_lo=max(8, max_new // 4), max_new_hi=max_new)
+    mixed_prompts = [s.tokens for s in wl.sample(n_requests, seed)]
+    out["serving_spec_draft_layers"] = draft_layers
+    for src, extra in (("ngram", {}),
+                       ("model", {"draft_model": "model",
+                                  "draft_layers": draft_layers})):
+        sched = build(rt_on.replace(**extra))
+        for p in mixed_prompts[:min(len(mixed_prompts), max_batch)]:
+            sched.submit(p, max_new_tokens=4)   # warm off the clock
+        sched.run_until_done(max_ticks=10 ** 6)
+        reqs = [sched.submit(p, max_new_tokens=max_new)
+                for p in mixed_prompts]
+        t0 = time.monotonic()
+        sched.run_until_done(max_ticks=10 ** 6)
+        wall = time.monotonic() - t0
+        unfinished = [r.id for r in reqs if r.state != "finished"]
+        if unfinished:
+            raise RuntimeError(
+                f"spec draft-source benchmark ({src}) left requests "
+                f"unfinished (ids {unfinished[:8]})")
+        m = sched.metrics()
+        out[f"spec_accept_rate_{src}"] = m.get("spec_accept_rate", 0.0)
+        out[f"spec_tokens_per_forward_{src}"] = \
+            m.get("spec_tokens_per_forward", 0.0)
+        out[f"serving_spec_{src}_tokens_per_sec"] = \
+            m["tokens_generated_total"] / wall
     return out
 
 

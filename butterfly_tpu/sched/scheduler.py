@@ -998,6 +998,12 @@ class Scheduler:
                 toks = req.all_tokens
                 rows[i, :len(toks)] = toks
                 lens[i] = len(toks)
+            # a model draft source reseeds the members' draft KV from
+            # the SAME rows (first token excluded — the draft_len ==
+            # hist_len - 1 invariant); no-op for stateless sources.
+            # Admission runs behind a full drain barrier, so no spec
+            # block is in flight against the donated draft state.
+            self.engine.draft_prefill(slots_arr, rows, lens)
             self._hist_dev = self._hist_dev.at[slots_arr].set(
                 jnp.asarray(rows)).at[slots_arr, lens].set(firsts)
             self._hist_len_dev = self._hist_len_dev.at[slots_arr].set(

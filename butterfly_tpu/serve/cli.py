@@ -123,8 +123,24 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--draft-source", default="ngram",
                    help="spec-block draft source (RuntimeConfig."
                         "draft_model): 'ngram' = prompt lookup over the "
-                        "device-side history; custom sources register "
-                        "via engine.serving.register_draft_source")
+                        "device-side history (free, earns ~0 on "
+                        "non-repetitive traffic); 'model' = a real "
+                        "on-device draft model (models/draft.py) whose "
+                        "per-round forward runs inside the jitted spec "
+                        "scan over its own rollback-exact KV cache; "
+                        "custom sources register via "
+                        "engine.serving.register_draft_source")
+    s.add_argument("--draft-layers", type=int, default=0,
+                   help="--draft-source model: derive the draft from "
+                        "the first N layers of the TARGET checkpoint "
+                        "(embed/unembed shared on-chip, zero extra HBM "
+                        "for them). 0 = auto (num_layers/4, floor 1); "
+                        "ignored with --draft-ckpt")
+    s.add_argument("--draft-ckpt", default=None,
+                   help="--draft-source model: load an independent "
+                        "narrow HF-format draft checkpoint (same "
+                        "vocabulary as the target — validated) instead "
+                        "of deriving by truncation")
     def positive_int(v):
         n = int(v)
         if n < 1:

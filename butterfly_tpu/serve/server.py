@@ -1030,6 +1030,8 @@ def run_server(args) -> int:
                        kv_quant=getattr(args, "kv_quant", "none"),
                        speculative_gamma=getattr(args, "speculate", 0),
                        draft_model=getattr(args, "draft_source", "ngram"),
+                       draft_layers=getattr(args, "draft_layers", 0),
+                       draft_ckpt=getattr(args, "draft_ckpt", None),
                        decode_steps_per_tick=getattr(
                            args, "decode_steps_per_tick", 1),
                        prefill_max_batch=getattr(

@@ -94,6 +94,15 @@ SLOW_TESTS = {
     "test_speculative_parity_grid",
     "test_speculative_per_request_opt_out",
     "test_speculative_parity_under_preemption_pressure",
+    # draft-model speculation grids (ISSUE 14; each combo compiles a
+    # scheduler + the draft programs — the fast tier still covers the
+    # path: test_draft_model_spec_greedy_parity anchors one operating
+    # point and test_draft_kv_rollback_exact pins the KV invariant)
+    "test_draft_model_spec_parity_grid",
+    "test_draft_model_spec_int8_parity",
+    "test_draft_model_seeded_sampling_reproducible",
+    "test_model_drafting_beats_ngram_on_mixed_chat",
+    "test_legacy_draft_fn_contract_still_registers",
     # fused-block scenarios that compile a second scheduler / a wide
     # scan (the fast tier still covers the fused path: every core
     # parity test decodes through it, incl. test_decode_steps_per_tick)

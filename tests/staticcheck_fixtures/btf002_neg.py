@@ -73,3 +73,29 @@ class WindowEngine:
             params, toks, self.cache, self._window, self._wlen)
         self.cache, self._wlen = cache, wlen
         return self._window         # NOT donated by the flush: clean read
+
+
+def _step_spec(params, hist, cache, dstate):
+    return hist, hist, cache, dstate
+
+
+class DraftEngine:
+    """Blessed draft-carry pattern (ISSUE 14): the spec program donates
+    the history AND the draft-model KV cache; both rebind from the
+    result before any later read (serving.py spec_block_async)."""
+
+    def __init__(self):
+        self._spec_progs = {}
+
+    def _spec_prog(self, r):
+        prog = self._spec_progs.get(r)
+        if prog is None:
+            prog = jax.jit(_step_spec, donate_argnums=(1, 3))
+            self._spec_progs[r] = prog
+        return prog
+
+    def spec_dispatch(self, params, r):
+        toks, hist, cache, dstate = self._spec_prog(r)(
+            params, self._hist, self.cache, self._draft_state)
+        self._hist, self.cache, self._draft_state = hist, cache, dstate
+        return toks, self._draft_state.length

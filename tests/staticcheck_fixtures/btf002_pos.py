@@ -1,12 +1,15 @@
 """BTF002 positive fixture: reads of donated references after dispatch.
 
-Expected findings: 4 —
+Expected findings: 5 —
 * a read of the donated cache in the statement after the dispatch,
 * the same handle re-passed on the next loop iteration without rebind,
 * a read of a tree donated to a locally-built donating jit,
 * a window-carry dispatch (ISSUE 12: factory program donating the
   cache AND the staged-window buffers) that rebinds the cache but
-  reads the donated window attribute afterwards.
+  reads the donated window attribute afterwards,
+* a spec-block dispatch (ISSUE 14: factory program donating the
+  history carry AND the draft-model KV cache) that rebinds the
+  history but reads the donated draft cache afterwards.
 """
 import jax
 
@@ -63,3 +66,30 @@ class WindowEngine:
             params, toks, self.cache, self._window, self._wlen)
         self.cache = cache          # cache rebound...
         return blk, self._window    # finding 4: window NOT rebound
+
+
+def _step_spec(params, hist, cache, dstate):
+    return hist, hist, cache, dstate
+
+
+class DraftEngine:
+    """The draft-model spec-block carry (ISSUE 14): one program donates
+    the token-history carry AND the draft model's KV cache
+    (serving.py's _spec_block_prog shape)."""
+
+    def __init__(self):
+        self._spec_progs = {}
+
+    def _spec_prog(self, r):
+        prog = self._spec_progs.get(r)
+        if prog is None:
+            prog = jax.jit(_step_spec, donate_argnums=(1, 3))
+            self._spec_progs[r] = prog
+        return prog
+
+    def stale_draft_cache_read(self, params, r):
+        toks, hist, cache, dstate = self._spec_prog(r)(
+            params, self._hist, self.cache, self._draft_state)
+        self._hist = hist               # history rebound...
+        self.cache = cache
+        return toks, self._draft_state  # finding 5: draft NOT rebound

@@ -114,6 +114,115 @@ def test_greedy_rows_match_accept_drafts():
         assert got == _accept_drafts(drafts[0].tolist(), greedy), trial
 
 
+def _draw_q(logits, q_logits, temps, n, top_k=0, top_p=1.0, seed=0):
+    """Real-proposal harness: per trial, the draft is SAMPLED from the
+    (scaled, filtered) proposal q — exactly what the on-device draft
+    model does (models/draft.py) — then scored by speculative_accept
+    with the same q_logits. The output law must still be the target's."""
+    scaled_q = _filter_logits(jnp.asarray(q_logits)
+                              / jnp.asarray(temps, jnp.float32)[:, None,
+                                                                None],
+                              top_k, top_p)
+
+    def one(k):
+        kd, ka = jax.random.split(k)
+        drafts = jax.random.categorical(kd, scaled_q[:, :GAMMA, :],
+                                        axis=-1).astype(jnp.int32)
+        em, na = speculative_accept(
+            jnp.asarray(logits), drafts, ka,
+            jnp.asarray(temps, jnp.float32), top_k, top_p,
+            q_logits=scaled_q[:, :GAMMA, :])
+        return em, na, drafts
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    em, na, dr = jax.jit(jax.vmap(one))(keys)
+    return np.asarray(em), np.asarray(na), np.asarray(dr)
+
+
+def test_real_q_first_token_marginal_matches_target():
+    """ISSUE 14: with a REAL proposal distribution q (draft model),
+    accept-w.p.-min(1, p/q) + residual-(p-q)+ resample must leave
+    P(emitted[0] = x) exactly p_0(x) — the Leviathan law for arbitrary
+    q, not just one-hot."""
+    rng = np.random.RandomState(7)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    q_logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    em, _, _ = _draw_q(logits, q_logits, [0.7], 20000)
+    emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
+    tgt = _target(logits[0, 0], 0.7)
+    assert np.abs(emp - tgt).max() < 0.015, (emp, tgt)
+
+
+def test_real_q_joint_two_position_law():
+    """Given the first draft accepted under real q, emitted[1] is still
+    distributed as p_1 — the joint law equals autoregressive sampling
+    from the target regardless of the proposal."""
+    rng = np.random.RandomState(8)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    # proposal concentrated near the target: plenty of accept mass
+    q_logits = logits + rng.randn(1, GAMMA + 1, V).astype(np.float32) * 0.3
+    em, na, _ = _draw_q(logits, q_logits, [0.8], 30000, seed=1)
+    sel = na[:, 0] >= 1
+    assert sel.sum() > 5000
+    emp = np.bincount(em[sel, 0, 1], minlength=V) / sel.sum()
+    tgt = _target(logits[0, 1], 0.8)
+    assert np.abs(emp - tgt).max() < 0.02
+
+
+def test_real_q_acceptance_rate_is_expected_min_ratio():
+    """P(n_acc >= 1) must equal sum_d q(d) min(1, p(d)/q(d)) — the
+    textbook acceptance mass of rejection sampling with proposal q."""
+    rng = np.random.RandomState(9)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    q_logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    _, na, _ = _draw_q(logits, q_logits, [1.0], 20000, seed=2)
+    p = _target(logits[0, 0], 1.0)
+    q = _target(q_logits[0, 0], 1.0)
+    want = float(np.sum(q * np.minimum(1.0, p / np.maximum(q, 1e-30))))
+    assert abs((na[:, 0] >= 1).mean() - want) < 0.015
+
+
+def test_real_q_greedy_rows_ignore_q():
+    """temp-0 rows keep the _accept_drafts semantics byte-for-byte no
+    matter what q says — the draft-model greedy parity contract."""
+    from butterfly_tpu.engine.engine import _accept_drafts
+    rng = np.random.RandomState(10)
+    for trial in range(10):
+        logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+        q_logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+        drafts = rng.randint(0, V, (1, GAMMA))
+        em, na = speculative_accept(
+            jnp.asarray(logits), jnp.asarray(drafts, jnp.int32),
+            jax.random.PRNGKey(trial), jnp.asarray([0.0], jnp.float32),
+            0, 1.0, q_logits=jnp.asarray(q_logits[:, :GAMMA, :]))
+        n = int(np.asarray(na)[0]) + 1
+        got = np.asarray(em)[0, :n].tolist()
+        greedy = np.argmax(logits[0], axis=-1)
+        assert got == _accept_drafts(drafts[0].tolist(), greedy), trial
+
+
+def test_real_q_opt_out_rows_sample_full_distribution():
+    """spec_mask=False rows under real q: one token from the FULL
+    target distribution — no accept test, no residual bias."""
+    rng = np.random.RandomState(11)
+    logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    q_logits = rng.randn(1, GAMMA + 1, V).astype(np.float32) * 2.0
+    scaled_q = _filter_logits(jnp.asarray(q_logits[:, :GAMMA, :]) / 0.7,
+                              0, 1.0)
+    drafts = np.asarray([[int(np.argmax(q_logits[0, 0])), 0, 0]])
+    keys = jax.random.split(jax.random.PRNGKey(12), 20000)
+    f = jax.jit(jax.vmap(lambda k: speculative_accept(
+        jnp.asarray(logits), jnp.asarray(drafts, jnp.int32), k,
+        jnp.asarray([0.7], jnp.float32), 0, 1.0,
+        jnp.asarray([False]), scaled_q)))
+    em, na = f(keys)
+    em, na = np.asarray(em), np.asarray(na)
+    assert (na == 0).all()
+    emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
+    tgt = _target(logits[0, 0], 0.7)
+    assert np.abs(emp - tgt).max() < 0.015
+
+
 def test_opt_out_rows_sample_full_distribution():
     """spec_mask=False rows must emit ONE token from the FULL target
     distribution — no draft acceptance, and critically no residual

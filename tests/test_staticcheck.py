@@ -54,7 +54,7 @@ EXPECTED_RULES = {
 #: SOME sites — the mutcheck analyzer mutants — fails loudly.
 POSITIVE_COUNTS = {
     "BTF001": 3,
-    "BTF002": 4,
+    "BTF002": 5,
     "BTF003": 5,
     "BTF004": 5,
     "BTF005": 6,

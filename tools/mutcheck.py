@@ -175,6 +175,18 @@ MUTANTS = [
      "wlen = jnp.where(live, wlen + m, wlen)",
      "wlen = jnp.where(live, wlen + C, wlen)",
      ["tests/test_sched.py"], {}),
+    # draft-model speculation (ISSUE 14): draft KV length advances by
+    # the DRAFTED count (the γ+1 micro-step writes stay live) instead
+    # of the accepted count — rejected drafts' K/V become attendable,
+    # the draft desynchronizes from the history (wrong positions, wrong
+    # context), and the draft_len == hist_len - 1 invariant breaks.
+    # Killed by the draft spec parity-grid file's rollback-exactness
+    # probe (tests/test_draft.py pins the invariant mid-flight on a
+    # rejection-heavy prompt).
+    ("butterfly_tpu/engine/serving.py",
+     "return dstate._replace(length=jnp.where(live, dlen0 + m, dlen0))",
+     "return dstate",
+     ["tests/test_draft.py"], {}),
     # warm-prefix flash prefill (ISSUE 13): drop the prefix-length mask
     # — every row would attend the FULL cached-prefix block run,
     # including recycled-buffer garbage past its start, zero padding,

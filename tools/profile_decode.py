@@ -83,6 +83,17 @@ def main() -> int:
     ap.add_argument("--gamma", type=int, default=4,
                     help="draft width for --spec (matches "
                          "RuntimeConfig.speculative_gamma)")
+    ap.add_argument("--draft-source", default="ngram",
+                    help="draft source for --spec (matches "
+                         "RuntimeConfig.draft_model): 'ngram' = prompt "
+                         "lookup, 'model' = the on-device draft model "
+                         "(its per-round micro-steps land inside the "
+                         "traced scan) — the ROADMAP item 3 TPU "
+                         "speedup point is this flag flip")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="truncation depth for --draft-source model "
+                         "(matches RuntimeConfig.draft_layers; 0 = "
+                         "num_layers/4, floor 1)")
     args = ap.parse_args()
 
     import jax
@@ -246,6 +257,8 @@ def _profile_spec_block(args, model, params, kv_quant: str) -> int:
                        max_seq_len=args.prompt_len + max_new + gamma + 16,
                        kv_quant=kv_quant, decode_steps_per_tick=k,
                        speculative_gamma=gamma,
+                       draft_model=args.draft_source,
+                       draft_layers=args.draft_layers,
                        prefill_chunk=max(512, args.prompt_len * args.batch))
     rng = np.random.RandomState(0)
     # harvest greedy continuations with a plain scheduler so the traced

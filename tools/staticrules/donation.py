@@ -3,8 +3,10 @@
 Past incident class: every decode/prefill/spec dispatch donates the KV
 pools (and the spec block donates the device token-history carry; the
 write-combined windowed blocks additionally donate the staged-window
-buffer + per-slot staged count — ISSUE 12's window carry, the same
-factory pattern) so XLA updates them in place. A host-side read of the donated reference
+buffer + per-slot staged count — ISSUE 12's window carry — and under a
+model draft source the spec block also donates the draft model's own
+KV cache, ISSUE 14's draft-cache carry; all the same factory pattern)
+so XLA updates them in place. A host-side read of the donated reference
 after the dispatch call observes freed/aliased memory — under paged
 serving this aliases garbage K/V under a valid page id, silently
 (PR 5's "in-flight writes must never land on reclaimed pages" is the
