@@ -80,6 +80,7 @@ from typing import Dict, List, Optional, Tuple
 from butterfly_tpu.cache.prefix import chain_block_hashes
 from butterfly_tpu.obs.registry import (
     LATENCY_BUCKETS, MetricsRegistry, render_parsed, sum_expositions)
+from butterfly_tpu.obs.ticklog import FlightRecorder
 from butterfly_tpu.obs.trace import Tracer, merge_fleet_trace
 from butterfly_tpu.router.policy import PrefixAffinityPolicy, affinity_key
 from butterfly_tpu.router.pool import Replica, ReplicaPool
@@ -191,6 +192,15 @@ class ControlPlaneState(RouterState):
             "Requests whose deadline budget expired at the control "
             "plane, by where (arrival, or the handoff leg about to "
             "run)", ("where",))
+        # Control-plane anomaly flight recorder (ISSUE 15): records the
+        # fleet-level event classes the replicas can't see — breaker
+        # transitions and control-plane deadline 504s — and joins the
+        # per-replica rings at GET /fleet/flightrecorder (events
+        # shifted onto this process's clock by the health-probe offset,
+        # exactly like the fleet trace merge).
+        self.flightrec = FlightRecorder()
+        pool.on_breaker_open = lambda rid: self.flightrec.note(
+            "breaker", replica=rid, transition="open")
 
     # -- planning -----------------------------------------------------------
 
@@ -245,6 +255,11 @@ class ControlPlaneState(RouterState):
     def record_deadline(self, where: str) -> None:
         with self._mlock:
             self._c_deadline.labels(where).inc()
+        self.flightrec.note("deadline_504", where=where)
+        # expiry-burst trigger: the control plane sees spent-budget
+        # storms the replicas never receive (504 before any leg runs)
+        self.flightrec.poll({"deadline_expired_total": sum(
+            c.value for c in self._c_deadline._children.values())})
 
     def fleet_counters(self) -> Dict[str, float]:
         hits = self._c_xfer_hits.value
@@ -373,7 +388,12 @@ class ControlPlaneState(RouterState):
     AUTOSCALE_GAUGES = ("queue_depth", "active_requests", "kv_pages_free",
                         "kv_pages_total", "inflight_depth",
                         "tokens_per_sec", "device_bubble_p50",
-                        "device_bubble_p95", "slo_burn_rate")
+                        "device_bubble_p95", "slo_burn_rate",
+                        # tick anatomy (ISSUE 15): host-bound vs
+                        # device-bound per replica — an autoscaler that
+                        # only sees queue depth can't tell which tier
+                        # needs more replicas vs a faster host path
+                        "tick_host_frac", "tick_phase_dominant_p95")
 
     def fleet_metrics_text(self) -> str:
         """The GET /fleet/metrics body: one exposition aggregating every
@@ -413,6 +433,56 @@ class ControlPlaneState(RouterState):
                          for rid, v in samples)
         return "\n".join(lines) + ("\n" if lines else "")
 
+    # -- fleet flight-recorder rollup ---------------------------------------
+
+    def flightrecorder_rollup(self) -> Dict:
+        """The GET /fleet/flightrecorder body: this control plane's own
+        anomaly ring (breaker transitions, control-plane 504s) merged
+        with every replica's /debug/flightrecorder dump on ONE clock —
+        each replica's wall-clock event stamps shift by the clock
+        offset the health prober estimated (the PR 7 trace-merge
+        timeline), so a fleet-wide anomaly reads as one ordered story.
+        Unreachable replicas degrade to an error entry, never a 500."""
+        sources: Dict[str, Dict] = {}
+        merged: List[Dict] = []
+        dumps: List[Dict] = []
+
+        def absorb(src: str, dump: Dict, offset: float) -> None:
+            evs = []
+            for ev in dump.get("events", ()):
+                ev2 = dict(ev)
+                ev2["source"] = src
+                ev2["t_fleet"] = float(ev.get("t_wall", 0.0)) - offset
+                evs.append(ev2)
+            merged.extend(evs)
+            for art in dump.get("dumps", ()):
+                dumps.append({"source": src, "offset_s": offset, **art})
+            sources[src] = {"events": len(evs),
+                            "dumps": len(dump.get("dumps", ())),
+                            "offset_s": offset,
+                            "triggers_fired":
+                                dump.get("triggers_fired", {})}
+
+        absorb("control", self.flightrec.dump(), 0.0)
+        for snap in self.pool.snapshot():
+            rid = snap["replica"]
+            offset = snap.get("clock_offset_s") or 0.0
+            try:
+                url = f"http://{rid}/debug/flightrecorder"
+                with urllib.request.urlopen(
+                        url, timeout=self.pool.probe_timeout) as resp:
+                    dump = json.loads(resp.read() or b"{}")
+            except Exception as e:  # down/restarting: degrade
+                sources[rid] = {"events": 0, "missing": True,
+                                "error": f"{type(e).__name__}: {e}"}
+                continue
+            if not dump.get("enabled"):
+                sources[rid] = {"events": 0, "enabled": False}
+                continue
+            absorb(rid, dump, offset)
+        merged.sort(key=lambda ev: ev["t_fleet"])
+        return {"sources": sources, "events": merged, "dumps": dumps}
+
 
 def make_fleet_handler(state: ControlPlaneState):
     """The control-plane HTTP handler: the router handler (proxy,
@@ -428,6 +498,8 @@ def make_fleet_handler(state: ControlPlaneState):
                 self._json(200, state.fleet_state())
             elif path == "/fleet/trace":
                 self._fleet_trace()
+            elif path == "/fleet/flightrecorder":
+                self._json(200, state.flightrecorder_rollup())
             elif path == "/fleet/metrics":
                 body = state.fleet_metrics_text().encode()
                 self.send_response(200)

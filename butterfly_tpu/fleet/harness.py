@@ -125,11 +125,16 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
     from butterfly_tpu.serve.server import ServerState, make_handler
     from butterfly_tpu.utils.tokenizer import ByteTokenizer
 
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+
     rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
                        page_size=page_size, num_pages=num_pages,
                        prefix_caching=True)
+    # flight recorder always on, like tracing: the fleet rollup
+    # (GET /fleet/flightrecorder) merges every replica's ring
     sched = Scheduler(ServingEngine(model, params, rt), tracer=Tracer(),
-                      slo_ttft_s=slo_ttft_s, slo_itl_s=slo_itl_s)
+                      slo_ttft_s=slo_ttft_s, slo_itl_s=slo_itl_s,
+                      flightrec=FlightRecorder())
     if warm:
         # compile prefill + decode off any measured clock, BOTH prefill
         # flavors: the first warm prompt runs the fresh program, the

@@ -13,4 +13,8 @@ from butterfly_tpu.obs.registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
 )
+from butterfly_tpu.obs.ticklog import (  # noqa: F401
+    FlightRecorder,
+    TickLog,
+)
 from butterfly_tpu.obs.trace import Tracer, summarize_timeline  # noqa: F401

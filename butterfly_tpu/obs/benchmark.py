@@ -278,6 +278,19 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     h_prefill = sched.registry.get("prefill_tokens")
     if h_prefill is not None:
         out["prefill_tokens_per_sec"] = h_prefill.sum / wall
+    # tick anatomy (ISSUE 15): per-phase attribution over the phase-2
+    # window — the software answer to "what are the top host terms"
+    # that ROADMAP item 1's TPU profile confirms — plus the host/device
+    # wall split and the per-cause barrier breakdown
+    for k in ("tick_phase_drain_p50", "tick_phase_drain_p95",
+              "tick_phase_admit_p50", "tick_phase_admit_p95",
+              "tick_phase_assemble_p50", "tick_phase_assemble_p95",
+              "tick_phase_dispatch_p50", "tick_phase_dispatch_p95",
+              "tick_host_frac", "tick_device_frac"):
+        if k in m:
+            out[k] = m[k]
+    out["drain_barriers_by_cause"] = {
+        c: v for c, v in sched.barrier_causes().items() if v}
     if isolated_decode_tok_s_chip:
         # serving / isolated-decode tok/s/chip: 1.0 = the serving stack
         # adds zero overhead over a bare fused decode loop
@@ -648,6 +661,20 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
               "itl_req_mean_p95", "prefix_cache_hit_tokens"):
         if k in res:
             out["mixed_" + k] = r(res[k])
+    # tick anatomy under the CONTESTED workload (ISSUE 15): the mixed
+    # phase is where admission/page_pressure barriers actually fire, so
+    # its per-cause breakdown is the acceptance evidence (>= 2 nonzero
+    # causes on the CPU smoke)
+    mm = sched.metrics()
+    for k in ("tick_phase_drain_p50", "tick_phase_drain_p95",
+              "tick_phase_admit_p50", "tick_phase_admit_p95",
+              "tick_phase_assemble_p50", "tick_phase_assemble_p95",
+              "tick_phase_dispatch_p50", "tick_phase_dispatch_p95",
+              "tick_host_frac", "tick_device_frac"):
+        if k in mm:
+            out["mixed_" + k] = r(mm[k])
+    out["mixed_drain_barriers_by_cause"] = {
+        c: v for c, v in sched.barrier_causes().items() if v}
     out["operating_points"] = sw["points"]
     out["operating_point_knee"] = (
         {k: r(v) for k, v in sw["knee"].items()} if sw["knee"] else None)
@@ -787,6 +814,11 @@ def run_chaos_benchmark(topology: str = "2p2d", *, clients: int = 3,
                         chaos=plan, slo_ttft_s=120.0, slo_itl_s=120.0,
                         warm_len=shared_len + tail)
     try:
+        # arm the control plane's flight recorder for the spent-budget
+        # burst below: 3 expiries inside the window is a deadline-
+        # expiry-burst anomaly at this soak's scale, so the soak also
+        # proves the post-mortem path end-to-end (ISSUE 15)
+        fleet.state.flightrec.expiry_burst = 3
         # phase 1 — the chaos load: faults fire across both tiers while
         # closed-loop clients demand terminal outcomes
         load = lg.run_load(fleet.url, clients=clients,
@@ -802,6 +834,14 @@ def run_chaos_benchmark(topology: str = "2p2d", *, clients: int = 3,
                               prefix_share=0.0, shared_len=shared_len,
                               tail_len=tail, max_tokens=max_tokens,
                               seed=seed + 1, deadline_ms=0.0)
+        # the fleet-wide flight-recorder rollup: control-plane +
+        # per-replica rings merged on the probe-offset clock, with the
+        # expiry-burst trigger's post-mortem artifact(s) attached
+        import json as _json
+        import urllib.request as _rq
+        with _rq.urlopen(fleet.url + "/fleet/flightrecorder",
+                         timeout=10.0) as resp:
+            flightrec = _json.loads(resp.read())
         shed = sum(r.sched.metrics().get("shed_total", 0.0)
                    for r in fleet.replicas)
         deadline = sum(
@@ -832,4 +872,11 @@ def run_chaos_benchmark(topology: str = "2p2d", *, clients: int = 3,
         "serving_shed_total": shed,
         "deadline_expired_total": deadline,
         "breaker_open_total": breaker_opens,
+        # flight-recorder evidence (ISSUE 15): the expiry burst must
+        # have produced at least one schema-valid post-mortem artifact
+        "chaos_flightrec_dumps": len(flightrec.get("dumps", ())),
+        "chaos_flightrec_reasons": sorted(
+            {d.get("reason") for d in flightrec.get("dumps", ())}),
+        "chaos_flightrec_sources": len(flightrec.get("sources", {})),
+        "chaos_flightrec_events": len(flightrec.get("events", ())),
     }

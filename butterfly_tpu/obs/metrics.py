@@ -63,6 +63,16 @@ HELP = {
     "uptime_seconds": "Server uptime",
     "prefix_cache_hit_tokens": "Prompt tokens served from the prefix cache",
     "prefix_cache_lookup_tokens": "Prompt tokens looked up in the prefix cache",
+    "tick_host_frac": "Fraction of tick wall time spent in host "
+                      "sections (1 - tick_device_frac): the "
+                      "host-bound-vs-device-bound autoscale signal "
+                      "(ISSUE 15 tick anatomy)",
+    "tick_device_frac": "Fraction of tick wall time blocked on the "
+                        "stacked device fetch",
+    "tick_phase_dominant_p95": "p95 seconds of the largest tick phase "
+                               "over the timeline-ring window — which "
+                               "host term dominates (see "
+                               "/debug/ticks and tools/tick_report.py)",
 }
 
 COUNTERS = {"requests_total", "requests_finished", "tokens_generated_total",

@@ -10,6 +10,7 @@ ring buffer for per-tick latency percentiles.
 from __future__ import annotations
 
 import contextlib
+import sys
 import time
 from collections import deque
 from typing import Dict, Iterator, Optional
@@ -28,10 +29,31 @@ def trace(logdir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
-def start_profiler_server(port: int = 9999) -> None:
-    """On-demand profiling for live servers (connect with TensorBoard)."""
-    import jax
-    jax.profiler.start_server(port)
+#: the live ProfilerServer (jax returns a handle that must stay
+#: referenced; dropping it would stop the server)
+_PROFILER_SERVER = None
+
+
+def start_profiler_server(port: int = 9999) -> bool:
+    """On-demand profiling for live servers (connect with TensorBoard/
+    XProf). Returns True when listening. Failure — jax without the
+    profiler plugin (ImportError), the port already bound, a second
+    start in one process — logs a warning and returns False instead of
+    crashing the serve entrypoint (`serve --profiler-port` is an
+    observability convenience, never worth taking the replica down)."""
+    global _PROFILER_SERVER
+    try:
+        import jax
+        _PROFILER_SERVER = jax.profiler.start_server(port)
+        return True
+    except ImportError as e:
+        print(f"[butterfly] profiler server unavailable (no xprof): {e}",
+              file=sys.stderr, flush=True)
+        return False
+    except Exception as e:  # port in use / double start / backend quirk
+        print(f"[butterfly] profiler server failed to start on :{port}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return False
 
 
 def annotate(name: str):

@@ -182,6 +182,11 @@ class ReplicaPool:
             if rid in self.replicas:
                 raise ValueError(f"duplicate backend {rid}")
             self.replicas[rid] = Replica(rid, host, port)
+        # optional observer for breaker OPEN transitions (the fleet
+        # control plane's flight recorder hooks this): called with the
+        # replica id, under the pool lock — must be quick and must
+        # never call back into the pool
+        self.on_breaker_open = None
         # per-replica outstanding gauge on the router's own registry
         self._g_out = None
         self._c_breaker_open = None
@@ -313,6 +318,11 @@ class ReplicaPool:
             r.breaker_opens += 1
             if self._c_breaker_open is not None:
                 self._c_breaker_open.labels(r.rid).inc()
+            if self.on_breaker_open is not None:
+                try:
+                    self.on_breaker_open(r.rid)
+                except Exception:
+                    pass  # an observer must never break routing
         r.breaker = "open"
         r.breaker_next_probe_t = time.monotonic() + self.breaker_cooldown
         if err:

@@ -62,6 +62,7 @@ from butterfly_tpu.engine.serving import (
     ServingEngine, bucket_len, sample_batched)
 from butterfly_tpu.obs.registry import (
     BATCH_BUCKETS, LATENCY_BUCKETS, TOKEN_BUCKETS, MetricsRegistry)
+from butterfly_tpu.obs.ticklog import TICK_PHASES, TickLog
 
 #: spec_accept_rate histogram buckets: acceptance fractions in [0, 1]
 #: (upper bounds; the 1.0 bucket is the all-drafts-accepted round)
@@ -148,8 +149,15 @@ class Scheduler:
     def __init__(self, engine: ServingEngine, seed: int = 0,
                  tracer=None, registry: Optional[MetricsRegistry] = None,
                  slo_ttft_s: Optional[float] = None,
-                 slo_itl_s: Optional[float] = None):
+                 slo_itl_s: Optional[float] = None,
+                 flightrec=None):
         self.engine = engine
+        # Anomaly flight recorder (obs/ticklog.py FlightRecorder),
+        # opt-in like the tracer: None keeps every call site a single
+        # attribute-is-None check. When on, the scheduler notes
+        # admission/preempt/shed/expiry/barrier/flush events into its
+        # bounded ring and polls the trigger predicates once per tick.
+        self.flightrec = flightrec
         # Tracing is opt-in: trace=None keeps every hot-path call site a
         # single None check (obs/trace.py overhead contract). When on,
         # the engine shares the tracer for dispatch-level events.
@@ -288,13 +296,20 @@ class Scheduler:
             "gamma) over emitted rounds of speculating requests — 0 "
             "means every round paid a full verify for one token",
             SPEC_ACCEPT_BUCKETS)
-        self._c_barriers = reg.counter(
+        # Barrier-cause accounting (ISSUE 15): the single counter grew
+        # a {cause} label so the bench can say WHICH membership-change
+        # class costs the pipeline. The unlabeled sum survives as the
+        # metrics()["drain_barriers_total"] compat key (and as the sum
+        # of the labeled children in the exposition).
+        self._c_barriers = reg.counter_family(
             "drain_barriers_total",
             "FULL drain barriers (every in-flight block fetched, "
-            "pipeline restarts cold). Compare with spec_forwards_total "
+            "pipeline restarts cold), by membership-change cause "
+            "(admission, finish, page_pressure, cancel, spec, idle, "
+            "expired, flush). Compare the sum with spec_forwards_total "
             "/ tick count: a healthy pipeline drains lazily and "
             "barriers only on membership changes, never once per "
-            "decode or spec round")
+            "decode or spec round", ("cause",))
         self._h_ttft = reg.histogram(
             "ttft_seconds",
             "Time to first token (submit -> first token drained)",
@@ -419,6 +434,35 @@ class Scheduler:
         # kept the device busy through the host section) for the
         # metrics() percentile keys bench.py reports
         self._bubbles: Deque[float] = deque(maxlen=4096)
+        # -- tick anatomy (ISSUE 15) -----------------------------------------
+        # Per-tick phase attribution: tick() zeroes the accumulator,
+        # the structural sections add their exclusive time.monotonic()
+        # deltas (host->host arithmetic only — the timers themselves
+        # must never sync, BTF003 covers these paths), and the record
+        # lands in the bounded timeline ring GET /debug/ticks serves.
+        self.ticklog = TickLog(capacity=512)
+        self._tick_phases: Dict[str, float] = {p: 0.0 for p in TICK_PHASES}
+        self._tick_causes: List[str] = []
+        # stacked-fetch device wait within this tick's drains: feeds
+        # the host/device split (tick_host_frac / tick_device_frac) —
+        # the fetch is the one tick section that blocks on the device
+        self._tick_fetch = 0.0
+        self._t_host_total = 0.0
+        self._t_device_total = 0.0
+        # per-phase histograms in the registry: real _bucket series per
+        # structural phase, so dashboards see distributions, not means
+        self._h_phase = {
+            p: reg.histogram(
+                f"tick_phase_{p}_seconds",
+                f"Host wall time of the '{p}' tick phase per tick "
+                "(docs/serving.md tick-pipeline vocabulary)",
+                LATENCY_BUCKETS)
+            for p in TICK_PHASES}
+
+    def _phase_add(self, name: str, dt: float) -> None:
+        """Accumulate one phase section's exclusive wall time into the
+        current tick's record (plain dict arithmetic — never a sync)."""
+        self._tick_phases[name] += dt
 
     # -- public API ---------------------------------------------------------
 
@@ -498,6 +542,9 @@ class Scheduler:
         if pred <= limit:
             return None
         self._c_shed.labels(priority).inc()
+        if self.flightrec is not None:
+            self.flightrec.note("shed", priority=priority,
+                                predicted_ttft_s=pred, limit_s=limit)
         # how long until enough backlog drains that the prediction
         # would pass — the honest Retry-After, not a constant
         return max(1.0, pred - limit)
@@ -518,7 +565,7 @@ class Scheduler:
         live = [r for r in self._all_live
                 if r.deadline_s is not None and now >= r.deadline_s]
         if live:
-            self._drain_inflight()
+            self._drain_inflight("expired")
             for req in live:
                 if not req.done:  # the drain may have finished it
                     self._expire(req, "running")
@@ -526,6 +573,9 @@ class Scheduler:
     def _expire(self, req: Request, where: str) -> None:
         req.expired_where = where
         self._c_deadline.labels(where).inc()
+        if self.flightrec is not None:
+            self.flightrec.note("deadline_504", id=req.id, where=where,
+                                tokens=len(req.output))
         self._finish(req, state="expired")
 
     def cancel(self, req: Request) -> None:
@@ -538,7 +588,7 @@ class Scheduler:
         if req.done:
             return
         if req.slot is not None and (self._inflight or self._pending_first):
-            self._drain_inflight()
+            self._drain_inflight("cancel")
             if req.done:
                 return  # the drain surfaced a natural finish
         if req in self.waiting:
@@ -639,9 +689,22 @@ class Scheduler:
         spec = self._spec_mode
         k = max(1, rt.decode_steps_per_tick)
         depth = max(1, rt.inflight_blocks)
+        # tick-anatomy reset: zero the phase accumulator (sections add
+        # their exclusive monotonic deltas below; drains self-accrue),
+        # clear the barrier-cause list, zero the fetch wait
+        t_tick0 = time.monotonic()
+        tp = self._tick_phases
+        for p in TICK_PHASES:
+            tp[p] = 0.0
+        self._tick_causes = []
+        self._tick_fetch = 0.0
         # deadline scrub first: an expired request must not survive
-        # into this tick's admission or decode dispatch
+        # into this tick's admission or decode dispatch (a drain it
+        # forces accrues to drain_barrier, not to expire)
+        d0 = self._drain_accrued()
         self._expire_due()
+        self._phase_add("expire", max(0.0, time.monotonic() - t_tick0
+                                      - (self._drain_accrued() - d0)))
         self._t_host0 = time.monotonic()
         self._had_inflight_at_host0 = bool(self._inflight)
         self._idle_at_host0 = self._had_inflight_at_host0 and \
@@ -651,14 +714,16 @@ class Scheduler:
         # finish surfacing there is a membership change -> full barrier.
         while len(self._inflight) >= depth:
             if self._drain_oldest():
-                self._drain_inflight()
+                self._drain_inflight("finish")
         # admission barrier — only when admission can actually make
         # progress, so a standing queue behind full slots doesn't
         # serialize the pipeline
         if self._prefill_group or (self.waiting
                                    and self._free_slot() is not None):
-            self._drain_inflight()
+            self._drain_inflight("admission")
+        t_admit = time.monotonic()
         self._admit()
+        self._phase_add("admit", time.monotonic() - t_admit)
         if self.running:
             self._h_batch.observe(len(self.running))
         # Preallocate pages for every step still in flight PLUS this
@@ -680,12 +745,18 @@ class Scheduler:
                 need = min(len(req.all_tokens) + horizon,
                            len(req.prompt) + req.max_new_tokens)
                 self._ensure_or_preempt(req, need)
+        t_disp = time.monotonic()
+        a0 = tp["assemble"]
         dispatched = self._spec_block(k) if spec else self._decode_block(k)
+        self._phase_add("dispatch", max(0.0, time.monotonic() - t_disp
+                                        - (tp["assemble"] - a0)))
         if not dispatched and (self._inflight or self._pending_first):
             # nothing dispatchable (every budget is spent on device):
             # the remaining tokens exist only in flight — fetch them
-            # now or the loop would spin forever
-            self._drain_inflight()
+            # now or the loop would spin forever. In spec mode this is
+            # the budget-carry reconciliation (only the device knows
+            # the remainders), hence the distinct cause label.
+            self._drain_inflight("spec" if spec else "idle")
         self._g_inflight.set(len(self._inflight))
         made = int(self._c_tokens.value - before)
         if self.trace is not None:
@@ -697,7 +768,46 @@ class Scheduler:
                              steps=k, block_steps=k, spec=spec,
                              inflight=len(self._inflight),
                              generated=made)
+        self._record_tick(time.monotonic() - t_tick0, made, spec)
         return made
+
+    def _drain_accrued(self) -> float:
+        """Drain-owned phase time accrued so far this tick (plain dict
+        reads): lets an enclosing section subtract the drains it
+        triggered, keeping the phase sections non-overlapping."""
+        tp = self._tick_phases
+        return (tp["drain_barrier"] + tp["drain_oldest"]
+                + tp["flush"] + tp["spec_emit"])
+
+    def _record_tick(self, wall: float, made: int, spec: bool) -> None:
+        """Close the tick's anatomy record: compute the residual
+        ("other" = untimed host work — page prealloc, trace appends),
+        feed the per-phase histograms, the host/device split, the
+        timeline ring, and the flight-recorder trigger poll. Host
+        arithmetic only — no device value is ever touched here."""
+        tp = self._tick_phases
+        known = sum(tp[p] for p in TICK_PHASES if p != "other")
+        tp["other"] = max(0.0, wall - known)
+        for name, h in self._h_phase.items():
+            h.observe(tp[name])
+        fetch = min(self._tick_fetch, wall)
+        self._t_device_total += fetch
+        self._t_host_total += max(0.0, wall - fetch)
+        self.ticklog.record(wall, tp, fetch_s=fetch,
+                            inflight=len(self._inflight),
+                            barrier_causes=self._tick_causes,
+                            batch=len(self.running),
+                            waiting=len(self.waiting),
+                            pages_free=self.alloc.free_pages,
+                            generated=made, spec=spec)
+        if self.flightrec is not None:
+            self.flightrec.poll({
+                "slo_burn_rate": self._g_slo_burn.value,
+                "preemptions_total": self._c_preempt.value,
+                "deadline_expired_total": sum(
+                    c.value for c in self._c_deadline._children.values()),
+                "queue_depth": float(len(self.waiting)),
+                "kv_pages_free": float(self.alloc.free_pages)})
 
     def metrics(self) -> Dict[str, float]:
         """Legacy flat-dict view, assembled from the typed registry.
@@ -718,7 +828,9 @@ class Scheduler:
             "preemptions_total": self._c_preempt.value,
             "spec_forwards_total": self._c_spec_fwd.value,
             "spec_drafts_accepted_total": self._c_spec_acc.value,
-            "drain_barriers_total": self._c_barriers.value,
+            # compat: the unlabeled sum over the {cause} family — the
+            # key every pre-ISSUE-15 consumer (spec bench, tests) reads
+            "drain_barriers_total": sum(self.barrier_causes().values()),
         }
         if self._spec_mode:
             fwd = self._c_spec_fwd.value
@@ -788,7 +900,35 @@ class Scheduler:
             m["kv_flush_p95"] = float(np.percentile(a, 95))
             m["kv_window_tokens_flushed_total"] = \
                 self._c_kv_flushed.value
+        # tick anatomy (ISSUE 15): per-phase p50/p95 over the timeline
+        # ring window ("drain" = lazy + barrier drains combined — the
+        # bench headline set), the host/device wall split, and the
+        # dominant phase's p95 (the autoscale gauge: a host-bound
+        # replica shows a fat admit/dispatch/drain phase, a
+        # device-bound one a fat fetch share)
+        pp = self.ticklog.phase_percentiles()
+        for name in ("drain", "admit", "assemble", "dispatch",
+                     "expire", "spec_emit", "flush"):
+            if name in pp:
+                m[f"tick_phase_{name}_p50"] = pp[name]["p50"]
+                m[f"tick_phase_{name}_p95"] = pp[name]["p95"]
+        if pp:
+            m["tick_phase_dominant_p95"] = max(
+                v["p95"] for k, v in pp.items() if k != "other")
+        total = self._t_host_total + self._t_device_total
+        if total > 0:
+            m["tick_host_frac"] = self._t_host_total / total
+            m["tick_device_frac"] = self._t_device_total / total
         return m
+
+    def barrier_causes(self) -> Dict[str, float]:
+        """Per-cause FULL-barrier counts: the drain_barriers_total
+        {cause=} family as a plain dict (bench.py's breakdown key —
+        which membership-change class is costing the pipeline)."""
+        fam = self._c_barriers
+        with fam._lock:
+            items = list(fam._children.items())
+        return {vals[0]: child.value for vals, child in items}
 
     # -- internals ----------------------------------------------------------
 
@@ -862,6 +1002,9 @@ class Scheduler:
             demand += len(req.all_tokens) - cached
             wait = time.monotonic() - req.t_enqueued
             self._h_queue_wait.observe(wait)
+            if self.flightrec is not None:
+                self.flightrec.note("admit", id=req.id, slot=slot,
+                                    queue_wait_s=wait, cached=cached)
             if self.trace is not None:
                 self.trace.event(req.id, "admit", slot=slot,
                                  queue_wait_s=wait,
@@ -1071,6 +1214,7 @@ class Scheduler:
         membership epoch: back-to-back blocks over an unchanged batch
         skip the per-slot Python rebuild and the np.asarray churn."""
         if self._operands_epoch != self._epoch:
+            t0 = time.monotonic()
             S = self.engine.num_slots
             active = np.zeros((S,), bool)
             temps = np.zeros((S,), np.float32)
@@ -1095,6 +1239,7 @@ class Scheduler:
                               {req.slot: (req, req.preemptions)
                                for req in self.running})
             self._operands_epoch = self._epoch
+            self._phase_add("assemble", time.monotonic() - t0)
         return self._operands
 
     def _note_bubble(self) -> None:
@@ -1153,17 +1298,33 @@ class Scheduler:
         self._note_bubble()
         return True
 
-    def _drain_inflight(self) -> bool:
+    def _drain_inflight(self, cause: str = "finish") -> bool:
         """FULL drain barrier: fetch every pending first token and
         in-flight block in ONE stacked device read. Returns True if any
         request finished. In spec mode the device budget carry resets
         to None — the host again knows every emitted token, so the
-        next dispatch reseeds it from exact host state."""
+        next dispatch reseeds it from exact host state.
+
+        `cause` labels the barrier in drain_barriers_total{cause=}
+        (the membership-change class that forced it: admission, finish,
+        page_pressure, cancel, spec, idle, expired, flush) and rides
+        the tick's timeline record + the flight-recorder ring."""
+        t0 = time.monotonic()
         if self._inflight or self._pending_first:
-            self._c_barriers.inc()
+            self._c_barriers.labels(cause).inc()
+            self._tick_causes.append(cause)
+            if self.flightrec is not None:
+                self.flightrec.note("barrier", cause=cause,
+                                    inflight=len(self._inflight))
         blocks, self._inflight = self._inflight, []
         self._spec_rem = None
-        return self._drain_blocks(blocks)
+        tp = self._tick_phases
+        sub0 = tp["flush"] + tp["spec_emit"]
+        out = self._drain_blocks(blocks)
+        self._phase_add("drain_barrier",
+                        max(0.0, time.monotonic() - t0
+                            - (tp["flush"] + tp["spec_emit"] - sub0)))
+        return out
 
     def _drain_oldest(self) -> bool:
         """Lazy-drain step: fetch the pending firsts and ONLY the
@@ -1171,9 +1332,15 @@ class Scheduler:
         device (the dispatch-ahead overlap — the device computes block
         t+1 while the host emits block t). Returns True if any request
         finished (the caller escalates that to a full barrier)."""
-        if not self._inflight:
-            return self._drain_blocks([])
-        return self._drain_blocks([self._inflight.pop(0)])
+        t0 = time.monotonic()
+        tp = self._tick_phases
+        sub0 = tp["flush"] + tp["spec_emit"]
+        out = self._drain_blocks([self._inflight.pop(0)]
+                                 if self._inflight else [])
+        self._phase_add("drain_oldest",
+                        max(0.0, time.monotonic() - t0
+                            - (tp["flush"] + tp["spec_emit"] - sub0)))
+        return out
 
     def _drain_blocks(self, blocks: List[tuple]) -> bool:
         """Fetch + emit the given decode blocks (ONE stacked device
@@ -1204,6 +1371,9 @@ class Scheduler:
             dt = time.monotonic() - t_flush
             self._h_kv_flush.observe(dt)
             self._kv_flushes.append(dt)
+            self._phase_add("flush", dt)
+            if self.flightrec is not None:
+                self.flightrec.note("flush", dispatch_s=dt)
         firsts, self._pending_first = self._pending_first, []
         self._pending_first_keys.clear()  # refreshed: all entries drain
         if not blocks and not firsts:
@@ -1223,8 +1393,13 @@ class Scheduler:
                 parts.append(valid3.astype(jnp.int32).reshape(-1))
         if flushed is not None:
             parts.append(flushed.reshape(1))  # trailing; offsets unaffected
+        # the ONE stacked device fetch: the only tick section that
+        # blocks on the device — timed for the tick_host_frac /
+        # tick_device_frac split (everything else in a tick is host)
+        t_fetch = time.monotonic()
         vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
             else np.asarray(parts[0])
+        self._tick_fetch += time.monotonic() - t_fetch
         if flushed is not None:
             self._c_kv_flushed.inc(int(vals[-1]))
         now = time.monotonic()
@@ -1246,7 +1421,9 @@ class Scheduler:
                 off += k * S * C
                 valid3 = vals[off:off + k * S * C].reshape(k, S, C) != 0
                 off += k * S * C
+                t_se = time.monotonic()
                 self._emit_spec(toks3, valid3, snapshot)
+                self._phase_add("spec_emit", time.monotonic() - t_se)
                 continue
             rows = vals[off:off + k * S].reshape(k, S)
             off += k * S
@@ -1407,7 +1584,7 @@ class Scheduler:
                                               self.alloc.pages_of(req.slot))
                 return
             if self._inflight or self._pending_first:
-                self._drain_inflight()
+                self._drain_inflight("page_pressure")
                 continue
             # batch-class requests are preferred victims (shed-first
             # priority semantics); within a class the youngest loses —
@@ -1446,6 +1623,10 @@ class Scheduler:
         restarts its prompt on readmission."""
         self._epoch += 1  # batch membership changes below
         self._c_preempt.inc()
+        if self.flightrec is not None:
+            self.flightrec.note("preempt", id=req.id, slot=req.slot,
+                                priority=req.priority,
+                                generated=len(req.output))
         if self.trace is not None:
             self.trace.event(req.id, "preempt", slot=req.slot,
                              state=req.state,

@@ -180,6 +180,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "experiences); recorded like --slo-ttft-ms")
 
     slo_flags(s)
+    s.add_argument("--profiler-port", type=int, default=0,
+                   help="start the on-demand XProf profiler server on "
+                        "this port (0 = off): TensorBoard/XProf can "
+                        "then trigger captures of the live replica. "
+                        "ImportError/port-in-use degrade to a logged "
+                        "warning, never a crash. POST /debug/profile "
+                        "{duration_ms} captures a duration-bounded "
+                        "trace of the live tick loop either way")
+    s.add_argument("--flightrec-dir", default=None, metavar="DIR",
+                   help="write anomaly flight-recorder post-mortem "
+                        "artifacts (JSON) here when a trigger fires "
+                        "(SLO burn, preemption storm, deadline-expiry "
+                        "burst, wedge latch); unset keeps them "
+                        "in-memory at GET /debug/flightrecorder only")
     s.add_argument("--inflight-blocks", type=positive_int, default=2,
                    help="decode blocks kept in flight on the device "
                         "(dispatch-ahead): block t+1 chains on block "

@@ -51,6 +51,7 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 from typing import Optional
 
 from butterfly_tpu.obs.metrics import ThroughputWindow, render_prometheus
@@ -61,6 +62,18 @@ class LockTimeout(RuntimeError):
     slow or hung tick holds it). Every HTTP path that can raise this
     answers 503 + Retry-After instead of pinning the handler thread —
     and the timeout is counted (server_lock_timeouts_total)."""
+
+
+class ProfilerUnavailable(RuntimeError):
+    """The jax.profiler capture could not start (no profiler plugin in
+    this build, a concurrent trace already running, an unwritable
+    logdir). POST /debug/profile answers 501 with the reason — the
+    graceful no-xprof fallback, never a crash."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight: one at a time (jax.profiler is
+    process-global). POST /debug/profile answers 409."""
 
 
 class StopSequenceMatcher:
@@ -160,6 +173,19 @@ class ServerState:
         # (which wedges the server and fails submit fast), so this
         # bound is a backstop, not the primary hang defense.
         self.submit_lock_timeout = 30.0
+        # -- live on-demand profiling (ISSUE 15) -----------------------------
+        # POST /debug/profile hands the LOOP THREAD a (duration, logdir)
+        # request; the loop starts/stops the jax.profiler trace BETWEEN
+        # its lock-holding tick sections, so the capture brackets live
+        # ticks without the handler (or the capture) ever holding the
+        # serving lock — admission proceeds normally for the whole
+        # capture window. _profile_guard (its own tiny mutex, never
+        # self.lock) only serializes concurrent capture requests.
+        self._profile_guard = threading.Lock()
+        self._profile_pending: Optional[tuple] = None
+        self._profile_active: Optional[tuple] = None
+        self._profile_result: Optional[dict] = None
+        self._profile_done = threading.Event()
         self.thread = threading.Thread(target=self._loop, daemon=True)
         # Optional HeartbeatMonitor (obs/health.py): the scheduler
         # thread beats after every tick and runs the probe in-thread
@@ -193,6 +219,13 @@ class ServerState:
         # iteration (error check in _loop); a truly hung tick never
         # reaches it, but then its host state is frozen and 503s flow.
         self.error = f"heartbeat failed: {self.heartbeat.last_error}"
+        # wedge latch -> flight-recorder post-mortem: freeze the event
+        # ring NOW (the tick loop may be the thing that died, so the
+        # per-tick trigger poll can't be relied on to fire)
+        fr = getattr(self.sched, "flightrec", None)
+        if fr is not None:
+            fr.note("wedge", error=self.error)
+            fr.trigger("wedge", {"error": self.error})
         if self.acquire_lock():
             try:
                 self.sched.abort_all()
@@ -229,6 +262,7 @@ class ServerState:
 
     def _loop(self) -> None:
         while not self.stop.is_set():
+            self._maybe_profile()
             if self.error:
                 # wedged (in-tick exception, or the watchdog latched
                 # while we were mid-tick): drain remaining work under
@@ -263,6 +297,107 @@ class ServerState:
                     self.heartbeat.maybe_probe()  # idle: probe in-thread
                 self.wake.wait(timeout=0.05)
                 self.wake.clear()
+
+    # -- live on-demand profiling (loop thread + handler threads) -------------
+
+    @staticmethod
+    def _profiler_start(logdir: str) -> None:
+        """Start the process-global jax.profiler trace (split out so
+        tests can force the no-xprof 501 path by monkeypatching)."""
+        import jax
+        jax.profiler.start_trace(logdir)
+
+    @staticmethod
+    def _profiler_stop() -> None:
+        import jax
+        jax.profiler.stop_trace()
+
+    def _maybe_profile(self) -> None:
+        """Runs on the scheduler loop thread, OUTSIDE the serving lock:
+        start a pending capture, stop an expired one. The capture
+        therefore brackets whole ticks of the live loop and never
+        blocks admission — the serving lock is untouched on this path
+        (the BTF004 contract; pinned by test)."""
+        req = self._profile_pending
+        if req is not None and self._profile_active is None:
+            self._profile_pending = None
+            dur_s, logdir = req
+            t0 = time.monotonic()
+            try:
+                self._profiler_start(logdir)
+            except Exception as e:  # no profiler plugin / busy / bad dir
+                self._profile_result = {
+                    "error": f"{type(e).__name__}: {e}"}
+                self._profile_done.set()
+                return
+            self._profile_active = (t0 + dur_s, logdir, t0)
+        act = self._profile_active
+        if act is not None and time.monotonic() >= act[0]:
+            self._profile_active = None
+            deadline, logdir, t0 = act
+            result = {"logdir": logdir,
+                      "duration_s": time.monotonic() - t0}
+            try:
+                self._profiler_stop()
+            except Exception as e:
+                result["error"] = f"{type(e).__name__}: {e}"
+            self._profile_result = result
+            self._profile_done.set()
+
+    def request_profile(self, duration_ms: float,
+                        logdir: Optional[str] = None) -> dict:
+        """POST /debug/profile body -> result. Blocks the HANDLER
+        thread (bounded: duration + slack) while the loop thread
+        captures; never touches the serving lock, so admission and
+        every other endpoint proceed normally through the capture."""
+        import glob
+        import tempfile
+        duration_ms = min(max(float(duration_ms), 10.0), 60000.0)
+        if not self._profile_guard.acquire(blocking=False):
+            raise ProfilerBusy("a profile capture is already running")
+        try:
+            if logdir is None:
+                logdir = tempfile.mkdtemp(prefix="butterfly_profile_")
+            self._profile_result = None
+            self._profile_done.clear()
+            self._profile_pending = (duration_ms / 1e3, str(logdir))
+            self.wake.set()  # an idle loop wakes to start the capture
+            if not self._profile_done.wait(timeout=duration_ms / 1e3 + 30.0):
+                # a truly hung tick never reaches _maybe_profile: drop
+                # the request so a later loop iteration doesn't start a
+                # stale capture, and tell the client
+                self._profile_pending = None
+                raise ProfilerUnavailable(
+                    "capture did not complete (tick loop stalled?)")
+            res = dict(self._profile_result or {})
+        finally:
+            self._profile_guard.release()
+        if "error" in res:
+            raise ProfilerUnavailable(res["error"])
+        res["duration_ms"] = duration_ms
+        res["files"] = sorted(
+            str(Path(p).relative_to(res["logdir"])) for p in glob.glob(
+                res["logdir"] + "/**/*", recursive=True)
+            if Path(p).is_file())
+        return res
+
+    def debug_ticks(self, n: Optional[int] = None) -> dict:
+        """GET /debug/ticks body: the bounded per-tick timeline ring
+        (obs/ticklog.py). Reads only the ring's own lock — a wedged
+        scheduler can still be inspected."""
+        log = getattr(self.sched, "ticklog", None)
+        if log is None:
+            return {"enabled": False, "ticks": []}
+        return {"enabled": True, **log.dump(n)}
+
+    def debug_flightrecorder(self, n: Optional[int] = None) -> dict:
+        """GET /debug/flightrecorder body: the anomaly event ring +
+        retained trigger artifacts ({"enabled": false} when the
+        scheduler was built without a recorder)."""
+        fr = getattr(self.sched, "flightrec", None)
+        if fr is None:
+            return {"enabled": False, "events": [], "dumps": []}
+        return fr.dump(n)
 
     # -- handler-thread API ---------------------------------------------------
 
@@ -322,6 +457,11 @@ class ServerState:
         with self._locked():
             if self.error:
                 raise RuntimeError("server wedged: " + self.error)
+            # full reconcile (cause="flush") before page bytes leave
+            # the process: drains every in-flight block and flushes the
+            # write-combined KV window, so the exported pool bytes are
+            # never missing staged-but-unflushed K/V
+            self.sched._drain_inflight("flush")
             return export_payload(self.sched, hex_hashes)
 
     def import_kv(self, payload: dict) -> dict:
@@ -437,6 +577,12 @@ def make_handler(state: ServerState):
             elif self.path.split("?")[0] == "/debug/requests":
                 n, request_id = self._query_debug()
                 self._json(200, state.debug_requests(n, request_id))
+            elif self.path.split("?")[0] == "/debug/ticks":
+                n, _ = self._query_debug()
+                self._json(200, state.debug_ticks(n))
+            elif self.path.split("?")[0] == "/debug/flightrecorder":
+                n, _ = self._query_debug()
+                self._json(200, state.debug_flightrecorder(n))
             else:
                 self._json(404, {"error": "not found"})
 
@@ -465,6 +611,8 @@ def make_handler(state: ServerState):
                 self._handle_completions()
             elif self.path == "/kv/import":
                 self._handle_kv_import()
+            elif self.path == "/debug/profile":
+                self._handle_profile()
             else:
                 self._json(404, {"error": "not found"})
 
@@ -502,6 +650,31 @@ def make_handler(state: ServerState):
             if self._rid:
                 body["request_id"] = self._rid
             return body
+
+        def _handle_profile(self):
+            """POST /debug/profile {duration_ms, logdir}: a
+            duration-bounded jax.profiler capture of the LIVE tick
+            loop. The capture runs on the scheduler loop thread and
+            never holds the serving lock — only this handler thread
+            blocks (bounded) waiting for the artifact. 501 = no xprof
+            in this build (graceful fallback, with reason); 409 = a
+            capture is already in flight."""
+            try:
+                body = self._read_body()
+                duration_ms = float(body.get("duration_ms", 1000.0))
+                logdir = body.get("logdir")
+                if logdir is not None:
+                    logdir = str(logdir)
+            except (ValueError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                self._json(200, state.request_profile(duration_ms, logdir))
+            except ProfilerBusy as e:
+                self._json(409, {"error": str(e)})
+            except ProfilerUnavailable as e:
+                self._json(501, {"error": str(e),
+                                 "reason": "no-xprof or capture failed"})
 
         def _handle_kv_import(self):
             try:
@@ -1051,9 +1224,28 @@ def run_server(args) -> int:
     # counters and the rolling burn-rate gauge.
     slo_ttft = getattr(args, "slo_ttft_ms", None)
     slo_itl = getattr(args, "slo_itl_ms", None)
+    # Anomaly flight recorder: always on for the serve entrypoint (one
+    # bounded ring; events are per-admission/per-barrier, never
+    # per-token). --flightrec-dir makes trigger artifacts land on disk
+    # as JSON post-mortems; without it they are held in memory and
+    # served at GET /debug/flightrecorder.
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    flightrec = FlightRecorder(
+        dump_dir=getattr(args, "flightrec_dir", None))
     sched = Scheduler(engine, tracer=tracer,
                       slo_ttft_s=slo_ttft / 1e3 if slo_ttft else None,
-                      slo_itl_s=slo_itl / 1e3 if slo_itl else None)
+                      slo_itl_s=slo_itl / 1e3 if slo_itl else None,
+                      flightrec=flightrec)
+    # On-demand XProf server (--profiler-port): TensorBoard/XProf can
+    # then trigger captures of the live process. Failure to start
+    # (port in use, no profiler plugin) logs and serves without it —
+    # POST /debug/profile still works either way.
+    prof_port = getattr(args, "profiler_port", 0)
+    if prof_port:
+        from butterfly_tpu.obs.profile import start_profiler_server
+        if start_profiler_server(prof_port):
+            print(f"[butterfly] xprof profiler server on :{prof_port}",
+                  flush=True)
     # Warm the serving programs (fresh-chunk prefill, warm-chunk
     # continuation, batched decode) before listening: the first user
     # doesn't pay 20-40s of XLA compile, and the heartbeat watchdog

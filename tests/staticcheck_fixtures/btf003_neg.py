@@ -27,3 +27,24 @@ class Sched:
         # the drain is the one blessed fetch point (not a hot function)
         vals = np.asarray(self._pending)
         return vals.tolist(), int(vals[0])
+
+
+class TickLog:
+    def record(self, wall_s, phases):
+        # the blessed tick-anatomy pattern: host floats + dict copies
+        # under a tiny lock — no device value anywhere near the ring
+        entry = {"wall_s": wall_s, "phases": dict(phases)}
+        with self._lock:
+            self._ring.append(entry)
+
+
+class FlightRecorder:
+    def note(self, kind, **attrs):
+        ev = {"kind": kind}
+        ev.update(attrs)
+        self._ring.append(ev)
+
+    def poll(self, signals):
+        # trigger predicates over a HOST dict snapshot: plain compares
+        burn = signals.get("slo_burn_rate", 0.0)
+        return burn >= self.threshold

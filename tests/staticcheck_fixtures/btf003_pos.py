@@ -1,7 +1,10 @@
 """BTF003 positive fixture: host syncs inside hot functions.
 
-Expected findings: 5 — .item(), .tolist(), np.asarray on a non-literal,
-jax.device_get, and int() over a device-carry name, all inside tick().
+Expected findings: 7 — .item(), .tolist(), np.asarray on a non-literal,
+jax.device_get, and int() over a device-carry name inside tick(), plus
+the ISSUE 15 timer/ticklog paths: a ticklog record() that .tolist()s a
+device value into its entry, and a flight-recorder poll() that float()s
+a device carry into a trigger signal.
 """
 import jax
 import numpy as np
@@ -16,3 +19,18 @@ class Sched:
         lst = self._next_dev.tolist()             # 4: .tolist()
         jax.device_get(logits)                    # 5: device_get
         return tok, arr, val, lst
+
+
+class TickLog:
+    def record(self, wall_s, phases):
+        # a per-tick record must never fetch device state to enrich
+        # its entry — that would put a sync in every tick
+        entry = {"wall_s": wall_s, "phases": dict(phases),
+                 "carry": self._carry_dev.tolist()}   # 6: .tolist()
+        self._ring.append(entry)
+
+
+class FlightRecorder:
+    def poll(self, signals):
+        burn = float(self._burn_dev)                  # 7: float over _dev
+        return burn >= self.threshold

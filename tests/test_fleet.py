@@ -434,6 +434,15 @@ def test_chaos_soak_terminal_outcomes():
     for key in ("serving_shed_total", "deadline_expired_total",
                 "breaker_open_total"):
         assert key in out
+    # flight recorder (ISSUE 15): the spent-deadline burst is a
+    # deadline-expiry-burst anomaly at this scale — the control plane's
+    # recorder must have produced a post-mortem artifact, and the
+    # /fleet/flightrecorder rollup must have merged every source
+    # (control plane + all four replicas)
+    assert out["chaos_flightrec_dumps"] >= 1
+    assert "expiry_burst" in out["chaos_flightrec_reasons"]
+    assert out["chaos_flightrec_sources"] == 5  # control + 2p + 2d
+    assert out["chaos_flightrec_events"] > 0
 
 
 # ---------------------------------------------------------------------------

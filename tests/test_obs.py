@@ -454,3 +454,200 @@ def test_trace_report_fleet_cli_smoke(tmp_path):
          "--fleet", str(plain)],
         capture_output=True, text=True, timeout=60)
     assert out2.returncode == 2 and "merged" in out2.stderr
+
+
+# -- tick anatomy: the timeline ring (ISSUE 15) -----------------------------
+
+def _tick_entry(log, wall=0.01, **kw):
+    phases = kw.pop("phases", {"admit": 0.002, "dispatch": 0.004,
+                               "drain_oldest": 0.003, "other": 0.001})
+    log.record(wall, phases, **kw)
+
+
+def test_ticklog_bounded_and_seq_monotonic():
+    from butterfly_tpu.obs.ticklog import TickLog
+    log = TickLog(capacity=4)
+    for i in range(10):
+        _tick_entry(log, wall=0.01 * (i + 1), batch=i)
+    d = log.dump()
+    assert len(d["ticks"]) == 4 and d["next_seq"] == 10
+    seqs = [t["seq"] for t in d["ticks"]]
+    assert seqs == sorted(seqs) == [6, 7, 8, 9]
+    # ?n=K limit semantics (the /debug/ticks query)
+    assert [t["seq"] for t in log.dump(n=2)["ticks"]] == [8, 9]
+    assert log.dump(n=0)["ticks"] == []
+    json.dumps(d)  # the /debug/ticks body must be JSON-ready
+
+
+def test_ticklog_record_copies_phases():
+    """The ring entry must not alias the scheduler's reusable phase
+    accumulator — zeroing it for the next tick would rewrite history."""
+    from butterfly_tpu.obs.ticklog import TickLog
+    log = TickLog()
+    phases = {"admit": 0.5}
+    log.record(0.5, phases)
+    phases["admit"] = 0.0
+    assert log.dump()["ticks"][0]["phases"]["admit"] == 0.5
+
+
+def test_ticklog_phase_percentiles_and_combined_drain():
+    from butterfly_tpu.obs.ticklog import TickLog
+    log = TickLog()
+    for i in range(20):
+        log.record(0.01, {"admit": 0.001 * i, "drain_oldest": 0.002,
+                          "drain_barrier": 0.003})
+    pp = log.phase_percentiles()
+    assert pp["drain"]["p50"] == pytest.approx(0.005)
+    assert pp["admit"]["p95"] >= pp["admit"]["p50"]
+    assert TickLog().phase_percentiles() == {}
+
+
+# -- anomaly flight recorder (ISSUE 15) -------------------------------------
+
+def _validate_artifact(art):
+    from butterfly_tpu.obs.ticklog import FLIGHTREC_SCHEMA
+    assert art["schema"] == FLIGHTREC_SCHEMA
+    for key in ("reason", "seed", "t_wall", "next_seq", "signals",
+                "event_counts", "events"):
+        assert key in art, key
+    json.dumps(art)
+
+
+def test_flight_recorder_ring_bounded():
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    fr = FlightRecorder(capacity=3)
+    for i in range(7):
+        fr.note("admit", id=i)
+    d = fr.dump()
+    assert d["enabled"] and len(d["events"]) == 3
+    assert [e["id"] for e in d["events"]] == [4, 5, 6]
+    seqs = [e["seq"] for e in d["events"]]
+    assert seqs == sorted(seqs)
+    json.dumps(d)
+
+
+def test_flight_recorder_slo_burn_trigger():
+    """The mutcheck discriminator: poll at burn >= threshold MUST dump
+    (threshold weakened to inf would silently never fire)."""
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    fr = FlightRecorder(slo_burn_threshold=0.5)
+    fr.note("admit", id=0)
+    assert fr.poll({"slo_burn_rate": 0.4}) is None
+    art = fr.poll({"slo_burn_rate": 0.6})
+    assert art is not None and art["reason"] == "slo_burn"
+    _validate_artifact(art)
+    assert art["signals"]["slo_burn_rate"] == 0.6
+    assert art["event_counts"] == {"admit": 1}
+    assert fr.dump()["triggers_fired"] == {"slo_burn": 1}
+
+
+def test_flight_recorder_burn_zero_never_fires():
+    """threshold 0 + burn 0 (no SLO declared anywhere) must stay
+    quiet: the recorder never alarms on an idle default setup."""
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    fr = FlightRecorder(slo_burn_threshold=0.0)
+    assert fr.poll({"slo_burn_rate": 0.0}) is None
+
+
+def test_flight_recorder_preempt_storm_and_cooldown():
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    fr = FlightRecorder(preempt_storm=3, cooldown_s=3600.0)
+    assert fr.poll({"preemptions_total": 0}) is None
+    assert fr.poll({"preemptions_total": 2}) is None
+    art = fr.poll({"preemptions_total": 3})
+    assert art is not None and art["reason"] == "preempt_storm"
+    _validate_artifact(art)
+    # cooldown: the signal staying bad must not spam artifacts
+    assert fr.poll({"preemptions_total": 9}) is None
+    assert len(fr.dump()["dumps"]) == 1
+
+
+def test_flight_recorder_expiry_burst_trigger():
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    fr = FlightRecorder(expiry_burst=2)
+    assert fr.poll({"deadline_expired_total": 0}) is None
+    art = fr.poll({"deadline_expired_total": 2})
+    assert art is not None and art["reason"] == "expiry_burst"
+
+
+def test_flight_recorder_wedge_trigger_and_dump_dir(tmp_path):
+    """The wedge latch calls trigger() directly (the tick loop may be
+    dead); with dump_dir set the artifact lands on disk as JSON."""
+    from butterfly_tpu.obs.ticklog import FlightRecorder
+    fr = FlightRecorder(dump_dir=str(tmp_path / "rec"))
+    fr.note("wedge", error="heartbeat failed")
+    art = fr.trigger("wedge", {"error": "heartbeat failed"})
+    _validate_artifact(art)
+    assert "path" in art
+    on_disk = json.loads(Path(art["path"]).read_text())
+    assert on_disk["reason"] == "wedge"
+    assert on_disk["events"][0]["kind"] == "wedge"
+
+
+# -- tools/tick_report.py smoke ---------------------------------------------
+
+def _synthetic_ticks(path, n=12):
+    from butterfly_tpu.obs.ticklog import TickLog
+    log = TickLog()
+    for i in range(n):
+        phases = {"expire": 0.0001, "drain_oldest": 0.001,
+                  "drain_barrier": 0.002 if i % 3 == 0 else 0.0,
+                  "admit": 0.003, "assemble": 0.0005,
+                  "dispatch": 0.004, "spec_emit": 0.0,
+                  "flush": 0.0002, "other": 0.0008}
+        wall = sum(phases.values())
+        log.record(wall, phases, fetch_s=0.0015, inflight=2,
+                   barrier_causes=["admission"] if i % 3 == 0 else [],
+                   batch=4, waiting=i % 2, pages_free=10, generated=8)
+    path.write_text(json.dumps({"enabled": True, **log.dump()}))
+    return path
+
+
+def test_tick_report_stats_and_reconciliation(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tick_report", REPO / "tools" / "tick_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    dump = mod.load_dump(str(_synthetic_ticks(tmp_path / "ticks.json")))
+    s = mod.phase_stats(dump)
+    assert s["ticks"] == 12
+    # THE acceptance property: phase sums reconcile with tick wall
+    assert abs(s["reconciliation"] - 1.0) <= 0.10
+    assert s["host_frac"] + s["device_frac"] == pytest.approx(1.0)
+    # top-terms order: totals descending, dispatch ahead of expire
+    totals = [p["total_s"] for p in s["phases"]]
+    assert totals == sorted(totals, reverse=True)
+    assert s["barrier_causes"] == {"admission": 4}
+    text = mod.render(dump)
+    assert "dispatch" in text and "barriers by cause" in text
+    # a non-dump file is a loud error
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1,2]")
+    with pytest.raises(ValueError):
+        mod.load_dump(str(bad))
+
+
+def test_tick_report_cli_smoke(tmp_path):
+    """Subprocess smoke (stdlib-only import path, like trace_report)."""
+    dump = _synthetic_ticks(tmp_path / "ticks.json")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tick_report.py"),
+         str(dump)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "12 tick(s)" in out.stdout
+    assert "phase sums account for" in out.stdout
+    out2 = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tick_report.py"),
+         str(dump), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out2.returncode == 0, out2.stderr
+    stats = json.loads(out2.stdout)
+    assert abs(stats["reconciliation"] - 1.0) <= 0.10
+    out3 = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tick_report.py"),
+         str(tmp_path / "nope.json")],
+        capture_output=True, text=True, timeout=60)
+    assert out3.returncode == 2 and "error:" in out3.stderr

@@ -1173,3 +1173,107 @@ def test_kv_window_spec_rejection_never_flushed():
         runs[wc] = stale_bytes(sched, req.slot)
     assert runs[True] == 0.0    # windowed pool: no stale spec bytes
     assert runs[False] > 0.0    # per-token path: rollback leaves them
+
+
+# ---------------------------------------------------------------------------
+# tick anatomy: per-phase attribution + barrier-cause accounting (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_tick_anatomy_ring_and_phase_reconciliation():
+    """Every tick lands one record in the timeline ring: monotonic
+    seq, the phase vocabulary, and phase sums reconciling with tick
+    wall time (the 'other' residual makes the accounting explicit).
+    The admission barrier-cause fires when a waiter admits while
+    blocks are in flight."""
+    from butterfly_tpu.obs.ticklog import TICK_PHASES
+
+    sched, params = make_sched(max_batch=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=12)
+    for _ in range(3):
+        sched.tick()  # fill the dispatch-ahead pipeline
+    r2 = sched.submit([3, 1], max_new_tokens=4)  # free slot + inflight
+    sched.run_until_done()
+    assert r1.state == r2.state == "finished"
+
+    dump = sched.ticklog.dump()
+    ticks = dump["ticks"]
+    assert ticks and dump["next_seq"] >= len(ticks)
+    seqs = [t["seq"] for t in ticks]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    for t in ticks:
+        assert set(t["phases"]) == set(TICK_PHASES)
+        total = sum(t["phases"].values())
+        # phase sums account for the tick wall (+-10%)
+        assert abs(total - t["wall_s"]) <= 0.1 * t["wall_s"] + 1e-6
+        assert 0.0 <= t["fetch_s"] <= t["wall_s"] + 1e-9
+        assert t["pages_free"] >= 0 and t["inflight"] >= 0
+
+    m = sched.metrics()
+    for k in ("tick_phase_drain_p50", "tick_phase_drain_p95",
+              "tick_phase_admit_p50", "tick_phase_dispatch_p95",
+              "tick_phase_dominant_p95"):
+        assert k in m, k
+    assert m["tick_host_frac"] + m["tick_device_frac"] == \
+        __import__("pytest").approx(1.0)
+    assert 0.0 < m["tick_host_frac"] < 1.0
+    assert m["tick_device_frac"] > 0.0  # the stacked fetch is real
+
+    causes = sched.barrier_causes()
+    assert causes.get("admission", 0) >= 1  # r2 admitted mid-pipeline
+    assert causes.get("finish", 0) >= 1
+    # compat: the unlabeled sum is preserved and equals the breakdown
+    assert m["drain_barriers_total"] == sum(causes.values())
+    # the per-tick records carry the same causes the family counted
+    ring_causes = [c for t in ticks for c in t["barrier_causes"]]
+    assert ring_causes.count("admission") == causes["admission"]
+
+
+def test_barrier_causes_page_pressure_and_cancel():
+    """The page_pressure cause fires when _ensure_or_preempt drains
+    before preempting (tiny pool, the existing pressure scenario); the
+    cancel cause when cancel() drains in-flight blocks."""
+    sched, params = make_sched(max_batch=2, max_seq=32, page=4,
+                               num_pages=6)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=10)
+    r2 = sched.submit([2, 4], max_new_tokens=10)
+    sched.run_until_done()
+    m = sched.metrics()
+    causes = sched.barrier_causes()
+    assert m["preemptions_total"] >= 1
+    assert causes.get("page_pressure", 0) >= 1
+
+    r3 = sched.submit([9, 9, 9], max_new_tokens=12)
+    for _ in range(2):
+        sched.tick()
+    assert sched._inflight  # blocks genuinely in flight
+    sched.cancel(r3)
+    assert r3.state == "cancelled"
+    assert sched.barrier_causes().get("cancel", 0) >= 1
+
+
+def test_flight_recorder_preempt_storm_dump_on_scheduler():
+    """End-to-end anomaly path: a page-pressure preemption storm on a
+    live scheduler trips the recorder and freezes a schema-valid
+    post-mortem carrying the admission/preempt/barrier event trail."""
+    from butterfly_tpu.obs.ticklog import FLIGHTREC_SCHEMA, FlightRecorder
+
+    fr = FlightRecorder(preempt_storm=1)
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=32, page_size=4,
+                       num_pages=6)
+    sched = Scheduler(ServingEngine(model, params, rt), flightrec=fr)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=10)
+    r2 = sched.submit([2, 4], max_new_tokens=10)
+    sched.run_until_done()
+    assert sched.metrics()["preemptions_total"] >= 1
+    dumps = list(fr.dumps)
+    assert dumps, "preemption storm must have tripped the recorder"
+    art = dumps[0]
+    assert art["schema"] == FLIGHTREC_SCHEMA
+    assert art["reason"] == "preempt_storm"
+    kinds = {e["kind"] for e in art["events"]}
+    assert "preempt" in kinds and "admit" in kinds and "barrier" in kinds
+    assert art["signals"]["preemptions_total"] >= 1
+    import json as _json
+    _json.dumps(art)  # artifact must be JSON-serializable

@@ -55,7 +55,7 @@ EXPECTED_RULES = {
 POSITIVE_COUNTS = {
     "BTF001": 3,
     "BTF002": 5,
-    "BTF003": 5,
+    "BTF003": 7,
     "BTF004": 5,
     "BTF005": 6,
     "BTF006": 3,

@@ -197,6 +197,14 @@ MUTANTS = [
      "mask = cols < start",
      "mask = cols >= 0",
      ["tests/test_warm_prefill.py"], {}),
+    # flight recorder (ISSUE 15): weaken the SLO-burn trigger predicate
+    # to threshold=inf — the anomaly post-mortem would silently never
+    # fire on a burning error budget. Killed by the trigger tests in
+    # tests/test_obs.py (poll at burn >= threshold must dump).
+    ("butterfly_tpu/obs/ticklog.py",
+     "if burn >= self.slo_burn_threshold and burn > 0.0:",
+     'if burn >= float("inf") and burn > 0.0:',
+     ["tests/test_obs.py"], {}),
     # workload generator: the Poisson arrival process ignores its rate
     # (every open-loop bench/sweep would silently offer ~1 req/s
     # regardless of the requested load) — the arrival-statistics test

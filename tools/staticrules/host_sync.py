@@ -32,11 +32,17 @@ from . import FileContext, Finding, Rule, call_name, dotted_name, register
 
 #: functions whose bodies must stay sync-free. Drain/emit functions are
 #: intentionally absent: the stacked drain is the one blessed fetch.
+#: The ISSUE 15 tick-anatomy paths (phase timers, the ticklog ring
+#: append, the flight-recorder note/poll) run once per tick inside the
+#: hot section, so they are IN the set: a timer that materialized a
+#: device value would reintroduce exactly the sync it exists to find.
 HOT_FUNCTIONS: Set[str] = {
     "tick", "_decode_block", "_spec_block", "_assemble", "_admit",
     "_admit_round", "_finish_prefill", "_note_bubble",
     "decode_block_async", "spec_block_async", "decode_active_async",
     "prefill_batch", "_sync_table",
+    "_phase_add", "_drain_accrued", "_record_tick",
+    "record", "note", "poll",
 }
 
 #: conventional device-resident value names in the hot path (plus any
@@ -87,7 +93,8 @@ class HostSyncRule(Rule):
                  "device value on the host (sync belongs to the "
                  "stacked drain)")
     scope = ("butterfly_tpu/engine/serving.py",
-             "butterfly_tpu/sched/scheduler.py")
+             "butterfly_tpu/sched/scheduler.py",
+             "butterfly_tpu/obs/ticklog.py")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

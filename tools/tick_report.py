@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Render a dumped GET /debug/ticks body: the tick-anatomy report.
+
+The software answer to "what are the top host terms in a serving tick"
+(ROADMAP item 1) — a top-terms table of the structural tick phases
+(total seconds, share of tick wall, p50/p95), the host/device wall
+split, the per-cause barrier counts, and a reconciliation line proving
+the phase sums account for the measured tick wall time.
+
+stdlib-only (no jax, no numpy): runs anywhere, like trace_report.py.
+
+Usage:  curl -s host:8000/debug/ticks > ticks.json
+        python tools/tick_report.py ticks.json [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or "ticks" not in dump:
+        raise ValueError(
+            f"{path} is not a /debug/ticks dump (expected a JSON object "
+            f"with a 'ticks' list)")
+    return dump
+
+
+def percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return float(s[idx])
+
+
+def phase_stats(dump: dict) -> dict:
+    """Aggregate the dump: per-phase totals/percentiles (sorted by
+    total, descending — the top-terms order), wall/fetch totals, and
+    barrier-cause counts."""
+    ticks = dump.get("ticks", [])
+    series: Dict[str, List[float]] = {}
+    wall_total = 0.0
+    fetch_total = 0.0
+    causes: Dict[str, int] = {}
+    for t in ticks:
+        wall_total += t.get("wall_s", 0.0)
+        fetch_total += t.get("fetch_s", 0.0)
+        for name, v in t.get("phases", {}).items():
+            series.setdefault(name, []).append(v)
+        for c in t.get("barrier_causes", ()):
+            causes[c] = causes.get(c, 0) + 1
+    phases = [{"phase": name,
+               "total_s": sum(vals),
+               "share": (sum(vals) / wall_total) if wall_total else 0.0,
+               "p50_s": percentile(vals, 50),
+               "p95_s": percentile(vals, 95)}
+              for name, vals in series.items()]
+    phases.sort(key=lambda p: -p["total_s"])
+    phase_sum = sum(p["total_s"] for p in phases)
+    return {
+        "ticks": len(ticks),
+        "wall_total_s": wall_total,
+        "phase_total_s": phase_sum,
+        # phase sums / tick wall: ~1.0 means the attribution accounts
+        # for the measured time (the acceptance property, +-10%)
+        "reconciliation": (phase_sum / wall_total) if wall_total else 1.0,
+        "host_frac": ((wall_total - fetch_total) / wall_total)
+        if wall_total else 0.0,
+        "device_frac": (fetch_total / wall_total) if wall_total else 0.0,
+        "phases": phases,
+        "barrier_causes": causes,
+    }
+
+
+def render(dump: dict) -> str:
+    s = phase_stats(dump)
+    lines = []
+    lines.append(f"{s['ticks']} tick(s), {s['wall_total_s']:.4f}s wall "
+                 f"(next_seq={dump.get('next_seq', '?')}, "
+                 f"ring capacity {dump.get('capacity', '?')})")
+    lines.append(f"host {100 * s['host_frac']:.1f}% / device-fetch "
+                 f"{100 * s['device_frac']:.1f}% of tick wall")
+    lines.append("")
+    lines.append(f"{'phase':>14} {'total_s':>10} {'share':>7} "
+                 f"{'p50_s':>10} {'p95_s':>10}")
+    for p in s["phases"]:
+        lines.append(f"{p['phase']:>14} {p['total_s']:>10.4f} "
+                     f"{100 * p['share']:>6.1f}% "
+                     f"{p['p50_s']:>10.5f} {p['p95_s']:>10.5f}")
+    lines.append("")
+    lines.append(f"phase sums account for "
+                 f"{100 * s['reconciliation']:.1f}% of tick wall")
+    if s["barrier_causes"]:
+        lines.append("")
+        lines.append("full drain barriers by cause:")
+        for cause, n in sorted(s["barrier_causes"].items(),
+                               key=lambda kv: -kv[1]):
+            lines.append(f"  {cause:>14} {n}")
+    else:
+        lines.append("no full drain barriers in the window")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a dumped GET /debug/ticks body")
+    ap.add_argument("dump", help="JSON file (the /debug/ticks body)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable aggregate instead of the table")
+    args = ap.parse_args(argv)
+    try:
+        dump = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(phase_stats(dump)))
+    else:
+        print(render(dump))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
