@@ -81,6 +81,8 @@ from butterfly_tpu.cache.prefix import chain_block_hashes
 from butterfly_tpu.obs.registry import (
     LATENCY_BUCKETS, MetricsRegistry, render_parsed, sum_expositions)
 from butterfly_tpu.obs.ticklog import FlightRecorder
+from butterfly_tpu.obs.timeseries import (
+    FLEET_TIMESERIES_SCHEMA, default_fleet_rules, evaluate_rules)
 from butterfly_tpu.obs.trace import Tracer, merge_fleet_trace
 from butterfly_tpu.router.policy import PrefixAffinityPolicy, affinity_key
 from butterfly_tpu.router.pool import Replica, ReplicaPool
@@ -201,6 +203,22 @@ class ControlPlaneState(RouterState):
         self.flightrec = FlightRecorder()
         pool.on_breaker_open = lambda rid: self.flightrec.note(
             "breaker", replica=rid, transition="open")
+        # Per-replica alert rules over the scrape-derived gauge history
+        # (ISSUE 16): rules are STATEFUL (rising-edge latch), so each
+        # replica gets its own set, built lazily at its first probe.
+        # The pool calls the hook outside its lock after every probe;
+        # fired alerts land in this flight recorder as `alert` events
+        # with the surrounding series attached.
+        self._replica_rules: Dict[str, list] = {}
+        pool.on_series_sample = self._on_series_sample
+
+    def _on_series_sample(self, rid: str, tail: list,
+                          missed: int) -> None:
+        rules = self._replica_rules.get(rid)
+        if rules is None:
+            rules = self._replica_rules[rid] = default_fleet_rules()
+        evaluate_rules(rules, tail, flightrec=self.flightrec,
+                       source=rid, missing=missed)
 
     # -- planning -----------------------------------------------------------
 
@@ -395,6 +413,14 @@ class ControlPlaneState(RouterState):
                         # needs more replicas vs a faster host path
                         "tick_host_frac", "tick_phase_dominant_p95")
 
+    #: consecutive failed /metrics scrapes after which a replica's
+    #: re-exported gauges are DROPPED from /fleet/metrics: a gauge
+    #: frozen at its last good value reads as a live flat line to an
+    #: autoscaler, which is worse than an absent series. Counters keep
+    #: the last good scrape through the outage (a sum that briefly
+    #: under-counts then catches up is the normal counter contract).
+    SCRAPE_STALE_AFTER = 3
+
     def fleet_metrics_text(self) -> str:
         """The GET /fleet/metrics body: one exposition aggregating every
         replica's last-scraped /metrics. Counters sum; histograms sum
@@ -416,9 +442,14 @@ class ControlPlaneState(RouterState):
                      "retained through transient failures)")
         lines.append("# TYPE butterfly_fleet_replicas_scraped gauge")
         lines.append(f"butterfly_fleet_replicas_scraped {len(by_rid)}")
-        # per-replica autoscale gauges, from each replica's own scrape
+        # per-replica autoscale gauges, from each replica's own scrape —
+        # minus replicas whose scrapes have been failing (stale-gauge
+        # drop: see SCRAPE_STALE_AFTER)
+        stale = set(self.pool.stale_scrapes(self.SCRAPE_STALE_AFTER))
         per_rep: Dict[str, List[Tuple[str, float]]] = {}
         for rid, families in sorted(by_rid.items()):
+            if rid in stale:
+                continue
             for key in self.AUTOSCALE_GAUGES:
                 fam = families.get(f"butterfly_{key}")
                 if not fam:
@@ -483,6 +514,72 @@ class ControlPlaneState(RouterState):
         merged.sort(key=lambda ev: ev["t_fleet"])
         return {"sources": sources, "events": merged, "dumps": dumps}
 
+    # -- fleet timeseries rollup --------------------------------------------
+
+    def fleet_timeseries(self) -> Dict:
+        """The GET /fleet/timeseries body: every replica's signal
+        history merged on ONE clock. Two sample populations per
+        replica, both tagged with their source:
+
+        * ``scrape:<rid>`` — the pool's scrape-derived gauge ring,
+          stamped on THIS process's wall clock at the probe RTT
+          midpoint (offset zero by construction);
+        * ``<rid>`` — the replica's own /debug/timeseries dump, its
+          wall stamps shifted by the health prober's clock-offset
+          estimate (the PR 7 trace-merge timeline).
+
+        Alert events ride along: each replica dump's fired alerts plus
+        the control plane's own `alert` flight-recorder events (the
+        per-replica flatline/slope rules). Unreachable replicas degrade
+        to an error entry, never a 500."""
+        sources: Dict[str, Dict] = {}
+        merged: List[Dict] = []
+        alerts: List[Dict] = []
+
+        def absorb(src: str, samples, offset: float) -> None:
+            n = 0
+            for s in samples:
+                s2 = dict(s)
+                s2["source"] = src
+                s2["t_fleet"] = float(s.get("t_wall", 0.0)) - offset
+                merged.append(s2)
+                n += 1
+            sources[src] = {"samples": n, "offset_s": offset}
+
+        for rid, ring in sorted(self.pool.series_by_replica().items()):
+            absorb(f"scrape:{rid}", ring, 0.0)
+        for snap in self.pool.snapshot():
+            rid = snap["replica"]
+            offset = snap.get("clock_offset_s") or 0.0
+            try:
+                url = f"http://{rid}/debug/timeseries"
+                with urllib.request.urlopen(
+                        url, timeout=self.pool.probe_timeout) as resp:
+                    dump = json.loads(resp.read() or b"{}")
+            except Exception as e:  # down/restarting: degrade
+                sources[rid] = {"samples": 0, "missing": True,
+                                "error": f"{type(e).__name__}: {e}"}
+                continue
+            if not dump.get("enabled"):
+                sources[rid] = {"samples": 0, "enabled": False}
+                continue
+            absorb(rid, dump.get("samples", ()), offset)
+            for a in dump.get("alerts", ()):
+                a2 = dict(a)
+                a2.setdefault("source", rid)
+                a2["t_fleet"] = float(a.get("t_wall", 0.0)) - offset
+                alerts.append(a2)
+        for ev in self.flightrec.dump().get("events", ()):
+            if ev.get("kind") == "alert":
+                a2 = dict(ev)
+                a2.setdefault("source", "control")
+                a2["t_fleet"] = float(ev.get("t_wall", 0.0))
+                alerts.append(a2)
+        merged.sort(key=lambda s: s["t_fleet"])
+        alerts.sort(key=lambda a: a["t_fleet"])
+        return {"schema": FLEET_TIMESERIES_SCHEMA, "sources": sources,
+                "samples": merged, "alerts": alerts}
+
 
 def make_fleet_handler(state: ControlPlaneState):
     """The control-plane HTTP handler: the router handler (proxy,
@@ -500,6 +597,8 @@ def make_fleet_handler(state: ControlPlaneState):
                 self._fleet_trace()
             elif path == "/fleet/flightrecorder":
                 self._json(200, state.flightrecorder_rollup())
+            elif path == "/fleet/timeseries":
+                self._json(200, state.fleet_timeseries())
             elif path == "/fleet/metrics":
                 body = state.fleet_metrics_text().encode()
                 self.send_response(200)

@@ -220,7 +220,11 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     # Phase 2 — staggered arrivals at utilization * measured capacity
     interarrival = max_new / (utilization * capacity)
 
-    sched = Scheduler(engine)
+    from butterfly_tpu.obs.timeseries import SignalRecorder, series_summary
+    # fast cadence: bench phases last seconds, not minutes, so the serve
+    # default of 1s would catch ~3 samples — too few for a slope
+    rec = SignalRecorder(interval_s=0.05, capacity=4096)
+    sched = Scheduler(engine, timeseries=rec)
     reqs = []
     t_start = time.monotonic()
     next_arrival = t_start
@@ -308,6 +312,10 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
               "itl_p50_tick_burst", "itl_p95_tick_burst"):
         if k in m:
             out[k] = m[k]
+    # downsampled signal-history summary (peak/mean/slope per signal)
+    # over the phase-2 window: how throughput and page headroom MOVED,
+    # not just their endpoint averages
+    out["serving_series_summary"] = series_summary(rec.dump())
     return out
 
 
@@ -621,7 +629,9 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
     warm.run_until_done(max_ticks=10 ** 6)
 
     slo_ttft_s = slo_ttft_ms / 1e3 if slo_ttft_ms else None
-    sched = Scheduler(engine, slo_ttft_s=slo_ttft_s)
+    from butterfly_tpu.obs.timeseries import SignalRecorder, series_summary
+    rec = SignalRecorder(interval_s=0.05, capacity=4096)
+    sched = Scheduler(engine, slo_ttft_s=slo_ttft_s, timeseries=rec)
     res = drive_open_loop(sched, specs, max_seconds=max_seconds)
 
     sweep_grid = grid
@@ -675,6 +685,10 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
             out["mixed_" + k] = r(mm[k])
     out["mixed_drain_barriers_by_cause"] = {
         c: v for c, v in sched.barrier_causes().items() if v}
+    # signal-history summary over the contested window: the preemption
+    # and pages-free series here are the ones that actually move (the
+    # acceptance evidence that the time-series ring sees contention)
+    out["mixed_series_summary"] = series_summary(rec.dump())
     out["operating_points"] = sw["points"]
     out["operating_point_knee"] = (
         {k: r(v) for k, v in sw["knee"].items()} if sw["knee"] else None)

@@ -70,6 +70,17 @@ class Counter:
     def value(self) -> float:
         return self._value
 
+    @staticmethod
+    def rate(prev: float, curr: float, dt: float) -> float:
+        """Per-second rate between two snapshots of a monotonic
+        counter, CLAMPED at 0.0: a restarted process re-exposes the
+        counter from zero, and a negative "rate" across that reset is
+        an artifact, not a signal (the SignalRecorder's delta path —
+        obs/timeseries.py — leans on this clamp)."""
+        if dt <= 0.0:
+            return 0.0
+        return max(0.0, (curr - prev) / dt)
+
     def render(self, prefix: str) -> List[str]:
         full = f"{prefix}_{self.name}" if prefix else self.name
         out = []
@@ -324,6 +335,24 @@ class MetricsRegistry:
             insts = list(self._instruments.values())
         return {i.name: i.value for i in insts
                 if isinstance(i, (Counter, Gauge))}
+
+    def snapshot(self) -> Dict[str, float]:
+        """Cheap name -> value snapshot for periodic sampling (the
+        SignalRecorder's per-interval read): plain counters/gauges as
+        their value, labeled families as the SUM over their children
+        (the per-label split stays on the exposition surface — a rate
+        series wants the total). Float reads only; no rendering."""
+        with self._lock:
+            insts = list(self._instruments.values())
+        out: Dict[str, float] = {}
+        for i in insts:
+            if isinstance(i, (Counter, Gauge)):
+                out[i.name] = i.value
+            elif isinstance(i, LabeledFamily):
+                with i._lock:
+                    out[i.name] = sum(
+                        c.value for c in i._children.values())
+        return out
 
     def render(self) -> str:
         """Prometheus exposition text for every instrument."""

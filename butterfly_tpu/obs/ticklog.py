@@ -98,12 +98,18 @@ class TickLog:
             self._ring.append(entry)
             self._seq += 1
 
-    def dump(self, n: Optional[int] = None) -> Dict[str, Any]:
+    def dump(self, n: Optional[int] = None,
+             since: Optional[int] = None) -> Dict[str, Any]:
         """JSON-ready snapshot: the GET /debug/ticks body and what
-        tools/tick_report.py consumes."""
+        tools/tick_report.py consumes. `since` pages by sequence
+        number (ticks with seq >= since; a since older than the ring's
+        tail returns what survived the wrap) — the incremental contract
+        tick_report --follow polls on, applied before the `n` limit."""
         with self._lock:
             ticks = list(self._ring)
             seq = self._seq
+        if since is not None:
+            ticks = [t for t in ticks if t["seq"] >= since]
         if n is not None and n >= 0:
             ticks = ticks[-n:] if n else []
         return {"capacity": self.capacity, "next_seq": seq,

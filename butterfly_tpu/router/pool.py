@@ -36,6 +36,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from typing import Dict, List, Optional
 
 LIVE = "live"
@@ -52,7 +53,8 @@ class Replica:
                  "next_probe_t", "last_error", "role", "free_pages",
                  "inflight", "clock_offset", "metrics_families",
                  "metrics_t", "breaker", "breaker_fails",
-                 "breaker_next_probe_t", "breaker_opens")
+                 "breaker_next_probe_t", "breaker_opens", "series",
+                 "scrape_fails")
 
     def __init__(self, rid: str, host: str, port: int):
         self.rid = rid
@@ -81,6 +83,17 @@ class Replica:
         # output) when the pool scrapes metrics; feeds /fleet/metrics
         self.metrics_families: Optional[dict] = None
         self.metrics_t: Optional[float] = None
+        # per-replica gauge history ring (ISSUE 16): each successful
+        # scrape appends one {"t_wall", "signals"} entry — this
+        # replica's signal trajectory as seen from the scraping
+        # process's clock — the /fleet/timeseries merge input and the
+        # window the control plane's per-replica alert rules read
+        self.series: deque = deque(maxlen=240)
+        # consecutive FAILED scrapes (scrape_metrics mode only; probe
+        # failures count too — a down replica reports nothing): drives
+        # the stale-gauge drop in /fleet/metrics and the
+        # replica-flatline alert rule
+        self.scrape_fails = 0
         self.fails = 0           # consecutive probe/connect failures
         self.probes = 0
         self.last_probe_t: Optional[float] = None
@@ -131,10 +144,30 @@ class Replica:
                 "free_pages": self.free_pages, "inflight": self.inflight,
                 "clock_offset_s": self.clock_offset,
                 "consecutive_failures": self.fails,
+                "scrape_fails": self.scrape_fails,
                 "breaker": self.breaker,
                 "breaker_fails": self.breaker_fails,
                 "breaker_opens": self.breaker_opens,
                 "probes": self.probes, "last_error": self.last_error}
+
+
+def _flat_gauges(families: dict) -> Dict[str, float]:
+    """Unlabeled gauge samples from a parsed /metrics exposition, keyed
+    by the short signal name (the `butterfly_` prefix stripped so the
+    fleet timeline and a replica's own /debug/timeseries speak the same
+    signal vocabulary). Labeled gauge families are skipped — a history
+    ring wants scalar trajectories."""
+    out: Dict[str, float] = {}
+    for name, fam in families.items():
+        if fam.get("type") != "gauge":
+            continue
+        v = fam["samples"].get((name, ()))
+        if v is None:
+            continue
+        short = name[len("butterfly_"):] \
+            if name.startswith("butterfly_") else name
+        out[short] = float(v)
+    return out
 
 
 def parse_backend(spec: str) -> tuple:
@@ -187,6 +220,13 @@ class ReplicaPool:
         # replica id, under the pool lock — must be quick and must
         # never call back into the pool
         self.on_breaker_open = None
+        # optional per-probe series observer (scrape_metrics mode): the
+        # control plane hooks this to run its per-replica alert rules
+        # (replica-flatline, pages-free-slope) against the gauge
+        # history. Called OUTSIDE the pool lock after each probe with
+        # (rid, recent series tail, consecutive scrape failures); must
+        # never call back into the pool
+        self.on_series_sample = None
         # per-replica outstanding gauge on the router's own registry
         self._g_out = None
         self._c_breaker_open = None
@@ -362,6 +402,8 @@ class ReplicaPool:
             ok, detail = None, f"{type(e).__name__}: {e}"
         w1 = time.time()
         scraped = self._scrape(r) if ok and self.scrape_metrics else None
+        series_tail = None
+        scrape_fails = 0
         with self._lock:
             r.probes += 1
             r.last_probe_t = now
@@ -387,13 +429,35 @@ class ReplicaPool:
                 if scraped is not None:
                     r.metrics_families = scraped
                     r.metrics_t = now
+                    r.scrape_fails = 0
+                    # gauge history append (ISSUE 16): stamped with the
+                    # probe RTT midpoint on THIS process's wall clock,
+                    # so the fleet merge needs no offset shift for
+                    # scrape-derived samples
+                    r.series.append({
+                        "t_wall": (w0 + w1) / 2.0,
+                        "signals": _flat_gauges(scraped)})
+                elif self.scrape_metrics:
+                    r.scrape_fails += 1
                 r.next_probe_t = now + self.probe_interval
             elif ok is False:  # wedged: degraded, normal re-probe cadence
                 r.liveness = DEGRADED
                 r.last_error = detail
+                if self.scrape_metrics:
+                    r.scrape_fails += 1
                 r.next_probe_t = now + self.probe_interval
             else:
+                if self.scrape_metrics:
+                    r.scrape_fails += 1
                 self._fail(r, detail, now)
+            if self.scrape_metrics and self.on_series_sample is not None:
+                series_tail = list(r.series)[-16:]
+                scrape_fails = r.scrape_fails
+        if series_tail is not None:
+            try:  # an observer must never break probing
+                self.on_series_sample(r.rid, series_tail, scrape_fails)
+            except Exception:
+                pass
 
     def _scrape(self, r: Replica):
         """Fetch + parse one replica's /metrics (network + parse OUTSIDE
@@ -416,6 +480,24 @@ class ReplicaPool:
             return {rid: r.metrics_families
                     for rid, r in self.replicas.items()
                     if r.metrics_families is not None}
+
+    def series_by_replica(self) -> Dict[str, List[dict]]:
+        """Each replica's scrape-derived gauge history ring (the
+        /fleet/timeseries merge input); empty rings are absent. Entries
+        are stamped on THIS process's wall clock (probe RTT midpoint),
+        so they merge at offset zero."""
+        with self._lock:
+            return {rid: list(r.series)
+                    for rid, r in self.replicas.items() if r.series}
+
+    def stale_scrapes(self, after: int) -> List[str]:
+        """Replica ids whose last `after`+ scrape attempts all failed:
+        their re-exported gauges are STALE (frozen at the last good
+        scrape) and /fleet/metrics drops them rather than serving a
+        flat line as live data."""
+        with self._lock:
+            return [rid for rid, r in self.replicas.items()
+                    if r.scrape_fails >= after]
 
     def _fail(self, r: Replica, err: str, now: float) -> None:
         """Shared connect-failure accounting (lock held): escalate

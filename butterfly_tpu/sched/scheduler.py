@@ -150,7 +150,7 @@ class Scheduler:
                  tracer=None, registry: Optional[MetricsRegistry] = None,
                  slo_ttft_s: Optional[float] = None,
                  slo_itl_s: Optional[float] = None,
-                 flightrec=None):
+                 flightrec=None, timeseries=None):
         self.engine = engine
         # Anomaly flight recorder (obs/ticklog.py FlightRecorder),
         # opt-in like the tracer: None keeps every call site a single
@@ -158,6 +158,14 @@ class Scheduler:
         # admission/preempt/shed/expiry/barrier/flush events into its
         # bounded ring and polls the trigger predicates once per tick.
         self.flightrec = flightrec
+        # Periodic signal-history recorder (obs/timeseries.py
+        # SignalRecorder), opt-in with the same None contract: when
+        # off, the per-tick cost is one attribute-is-None check; when
+        # on, _record_tick asks due() (one monotonic compare) and
+        # samples the gauge/rate signal set at the recorder's interval.
+        # It lives on the scheduler — not the server — so bench runs
+        # record trajectories without an HTTP surface.
+        self.timeseries = timeseries
         # Tracing is opt-in: trace=None keeps every hot-path call site a
         # single None check (obs/trace.py overhead contract). When on,
         # the engine shares the tracer for dispatch-level events.
@@ -808,6 +816,43 @@ class Scheduler:
                     c.value for c in self._c_deadline._children.values()),
                 "queue_depth": float(len(self.waiting)),
                 "kv_pages_free": float(self.alloc.free_pages)})
+        ts = self.timeseries
+        if ts is not None and ts.due():
+            gauges, rates = self._timeseries_signals()
+            ts.sample(gauges, rates=rates, t_wall=time.time())
+
+    def _timeseries_signals(self):
+        """The SignalRecorder's per-interval snapshot (gauges, rates):
+        cheap host reads off the registry + tick anatomy. `rates` maps
+        OUTPUT signal name -> CUMULATIVE counter value — the recorder
+        turns them into per-second deltas (Counter.rate, clamped at 0
+        across resets). Runs only when the recorder is due, never per
+        tick."""
+        snap = self.registry.snapshot()
+        gauges = {
+            "queue_depth": float(len(self.waiting)),
+            "active_requests": float(len(self._all_live)),
+            "inflight_depth": float(len(self._inflight)),
+            "kv_pages_free": float(self.alloc.free_pages),
+            "slo_burn_rate": self._g_slo_burn.value,
+        }
+        total = self._t_host_total + self._t_device_total
+        if total > 0.0:
+            gauges["tick_host_frac"] = self._t_host_total / total
+        pp = self.ticklog.phase_percentiles()
+        if pp:
+            gauges["tick_phase_dominant_p95"] = max(
+                v["p95"] for k, v in pp.items() if k != "other")
+        rates = {
+            "tokens_per_sec": snap.get("tokens_generated_total", 0.0),
+            "preemptions_per_sec": snap.get("preemptions_total", 0.0),
+            "shed_per_sec": snap.get("shed_total", 0.0),
+            "deadline_expired_per_sec":
+                snap.get("deadline_expired_total", 0.0),
+        }
+        for cause, v in self.barrier_causes().items():
+            rates[f"barrier_{cause}_per_sec"] = v
+        return gauges, rates
 
     def metrics(self) -> Dict[str, float]:
         """Legacy flat-dict view, assembled from the typed registry.

@@ -203,6 +203,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop; the device_bubble_seconds histogram "
                         "shows whether the depth is enough to keep the "
                         "device busy through a tick's host section")
+    s.add_argument("--timeseries-interval", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="periodic signal-history sampling interval for "
+                        "GET /debug/timeseries (the bounded ring "
+                        "tools/dashboard.py renders; alert rules note "
+                        "threshold crossings into the flight "
+                        "recorder). 0 disables the recorder entirely "
+                        "(zero extra per-tick host work)")
 
     b = sub.add_parser("bench", help="throughput microbenchmark")
     common(b)
@@ -415,6 +423,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also print suppressed findings")
     li.add_argument("--force", action="store_true",
                     help="ignore per-rule scopes (ad-hoc sweeps)")
+
+    # timeseries dashboard renderer (tools/dashboard.py, ISSUE 16):
+    # stdlib-only like `lint` — loads no model, touches no accelerator.
+    d = sub.add_parser("dash",
+                       help="render a dumped /debug/timeseries or "
+                            "/fleet/timeseries body as a static HTML "
+                            "dashboard (SVG sparklines, alert "
+                            "annotations) or --text sparklines")
+    d.add_argument("dump", help="JSON file (the timeseries body)")
+    d.add_argument("--out", default=None,
+                   help="write HTML here (default: stdout)")
+    d.add_argument("--text", action="store_true",
+                   help="unicode sparklines for terminals instead of "
+                        "HTML")
     return p
 
 
@@ -792,12 +814,37 @@ def cmd_lint(args) -> int:
     return staticcheck.main(argv)
 
 
+def cmd_dash(args) -> int:
+    """`butterfly dash`: the stdlib timeseries dashboard renderer
+    (tools/dashboard.py) from the package entrypoint — same source-
+    checkout contract as `butterfly lint`."""
+    import importlib
+    from pathlib import Path
+
+    tools = Path(__file__).resolve().parent.parent.parent / "tools"
+    if not (tools / "dashboard.py").exists():
+        print("error: butterfly dash needs the repo's tools/ directory "
+              "(run from a source checkout)", file=sys.stderr)
+        return 2
+    sys.path.insert(0, str(tools))
+    try:
+        dashboard = importlib.import_module("dashboard")
+    finally:
+        sys.path.remove(str(tools))
+    argv = [args.dump]
+    if args.out:
+        argv += ["--out", args.out]
+    if args.text:
+        argv.append("--text")
+    return dashboard.main(argv)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"generate": cmd_generate, "serve": cmd_serve,
             "bench": cmd_bench, "route": cmd_route,
             "fleet": cmd_fleet, "workload": cmd_workload,
-            "lint": cmd_lint}[args.cmd](args)
+            "lint": cmd_lint, "dash": cmd_dash}[args.cmd](args)
 
 
 if __name__ == "__main__":

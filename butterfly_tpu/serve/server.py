@@ -381,14 +381,16 @@ class ServerState:
             if Path(p).is_file())
         return res
 
-    def debug_ticks(self, n: Optional[int] = None) -> dict:
+    def debug_ticks(self, n: Optional[int] = None,
+                    since: Optional[int] = None) -> dict:
         """GET /debug/ticks body: the bounded per-tick timeline ring
         (obs/ticklog.py). Reads only the ring's own lock — a wedged
-        scheduler can still be inspected."""
+        scheduler can still be inspected. `since` pages by tick seq
+        (tick_report --follow's incremental poll)."""
         log = getattr(self.sched, "ticklog", None)
         if log is None:
             return {"enabled": False, "ticks": []}
-        return {"enabled": True, **log.dump(n)}
+        return {"enabled": True, **log.dump(n, since=since)}
 
     def debug_flightrecorder(self, n: Optional[int] = None) -> dict:
         """GET /debug/flightrecorder body: the anomaly event ring +
@@ -398,6 +400,18 @@ class ServerState:
         if fr is None:
             return {"enabled": False, "events": [], "dumps": []}
         return fr.dump(n)
+
+    def debug_timeseries(self, since: Optional[int] = None,
+                         signals=None) -> dict:
+        """GET /debug/timeseries body: the periodic signal-history ring
+        (obs/timeseries.py SignalRecorder). Reads only the ring's own
+        lock — the /debug/ticks wedge-readability contract.
+        ({"enabled": false} when serving with --timeseries-interval 0.)
+        """
+        rec = getattr(self.sched, "timeseries", None)
+        if rec is None:
+            return {"enabled": False, "samples": [], "alerts": []}
+        return rec.dump(since=since, signals=signals)
 
     # -- handler-thread API ---------------------------------------------------
 
@@ -575,14 +589,19 @@ def make_handler(state: ServerState):
                 self.end_headers()
                 self.wfile.write(body)
             elif self.path.split("?")[0] == "/debug/requests":
-                n, request_id = self._query_debug()
-                self._json(200, state.debug_requests(n, request_id))
+                q = self._query_debug()
+                self._json(200, state.debug_requests(
+                    q["n"], q["request_id"]))
             elif self.path.split("?")[0] == "/debug/ticks":
-                n, _ = self._query_debug()
-                self._json(200, state.debug_ticks(n))
+                q = self._query_debug()
+                self._json(200, state.debug_ticks(q["n"], q["since"]))
             elif self.path.split("?")[0] == "/debug/flightrecorder":
-                n, _ = self._query_debug()
-                self._json(200, state.debug_flightrecorder(n))
+                q = self._query_debug()
+                self._json(200, state.debug_flightrecorder(q["n"]))
+            elif self.path.split("?")[0] == "/debug/timeseries":
+                q = self._query_debug()
+                self._json(200, state.debug_timeseries(
+                    q["since"], q["signals"]))
             else:
                 self._json(404, {"error": "not found"})
 
@@ -591,17 +610,29 @@ def make_handler(state: ServerState):
             return str(rid)[:128] if rid is not None else None
 
         def _query_debug(self):
-            """/debug/requests query: (?n=K limit, ?request_id= client
-            id filter); (None, None) when absent/bad."""
+            """Shared /debug/* query parsing: ?n=K limit, ?request_id=
+            client-id filter, ?since=SEQ incremental pagination
+            (ticks/timeseries), ?signals=a,b signal-name filter
+            (timeseries). Absent/bad fields parse as None — a bad query
+            degrades to the full dump, never a 500."""
             from urllib.parse import parse_qs, urlparse
+            out = {"n": None, "request_id": None, "since": None,
+                   "signals": None}
             try:
                 qs = parse_qs(urlparse(self.path).query)
-                n = int(qs["n"][0]) if "n" in qs else None
-                rid = str(qs["request_id"][0])[:128] \
-                    if "request_id" in qs else None
-                return n, rid
+                if "n" in qs:
+                    out["n"] = int(qs["n"][0])
+                if "request_id" in qs:
+                    out["request_id"] = str(qs["request_id"][0])[:128]
+                if "since" in qs:
+                    out["since"] = int(qs["since"][0])
+                if "signals" in qs:
+                    out["signals"] = [s for s in
+                                      ",".join(qs["signals"]).split(",")
+                                      if s]
             except (ValueError, TypeError, IndexError):
-                return None, None
+                pass
+            return out
 
         def do_POST(self):
             self._rid = self._header_rid()
@@ -1232,10 +1263,24 @@ def run_server(args) -> int:
     from butterfly_tpu.obs.ticklog import FlightRecorder
     flightrec = FlightRecorder(
         dump_dir=getattr(args, "flightrec_dir", None))
+    # Periodic signal-history recorder (GET /debug/timeseries): on by
+    # default at 1 Hz — one bounded ring append per interval, zero per-
+    # tick cost beyond a monotonic compare. --timeseries-interval 0
+    # disables it entirely (timeseries=None: one is-None check/tick).
+    # Its alert rules note structured `alert` events into the same
+    # flight recorder, so threshold crossings land in post-mortems.
+    ts_interval = getattr(args, "timeseries_interval", 1.0)
+    timeseries = None
+    if ts_interval and ts_interval > 0:
+        from butterfly_tpu.obs.timeseries import (SignalRecorder,
+                                                  default_rules)
+        timeseries = SignalRecorder(interval_s=ts_interval,
+                                    rules=default_rules(),
+                                    flightrec=flightrec)
     sched = Scheduler(engine, tracer=tracer,
                       slo_ttft_s=slo_ttft / 1e3 if slo_ttft else None,
                       slo_itl_s=slo_itl / 1e3 if slo_itl else None,
-                      flightrec=flightrec)
+                      flightrec=flightrec, timeseries=timeseries)
     # On-demand XProf server (--profiler-port): TensorBoard/XProf can
     # then trigger captures of the live process. Failure to start
     # (port in use, no profiler plugin) logs and serves without it —

@@ -48,3 +48,20 @@ class FlightRecorder:
         # trigger predicates over a HOST dict snapshot: plain compares
         burn = signals.get("slo_burn_rate", 0.0)
         return burn >= self.threshold
+
+
+class SignalRecorder:
+    def sample(self, gauges, rates=None, t_wall=0.0):
+        # the blessed time-series pattern: caller hands in host floats
+        # (registry snapshot + len()s), the ring sees no device values
+        signals = dict(gauges)
+        for name, cum in (rates or {}).items():
+            signals[name] = max(0.0, cum - self._prev.get(name, 0.0))
+        self._ring.append({"t_wall": t_wall, "signals": signals})
+
+
+def evaluate_rules(rules, samples):
+    # predicates over host sample dicts: plain float compares
+    return [r for r in rules
+            if samples and samples[-1]["signals"].get(r.signal, 0.0)
+            > r.threshold]

@@ -1,10 +1,12 @@
 """BTF003 positive fixture: host syncs inside hot functions.
 
-Expected findings: 7 — .item(), .tolist(), np.asarray on a non-literal,
+Expected findings: 9 — .item(), .tolist(), np.asarray on a non-literal,
 jax.device_get, and int() over a device-carry name inside tick(), plus
 the ISSUE 15 timer/ticklog paths: a ticklog record() that .tolist()s a
 device value into its entry, and a flight-recorder poll() that float()s
-a device carry into a trigger signal.
+a device carry into a trigger signal, plus the ISSUE 16 time-series
+paths: a recorder sample() that .item()s a gauge off the device, and an
+evaluate_rules() that float()s a device carry into a predicate.
 """
 import jax
 import numpy as np
@@ -34,3 +36,18 @@ class FlightRecorder:
     def poll(self, signals):
         burn = float(self._burn_dev)                  # 7: float over _dev
         return burn >= self.threshold
+
+
+class SignalRecorder:
+    def sample(self, gauges, rates=None, t_wall=0.0):
+        # the periodic sampler runs in the tick tail: pulling a gauge
+        # straight off the device puts a sync in every sample period
+        gauges["kv_pages_free"] = self._pages_dev.item()   # 8: .item()
+        self._ring.append({"signals": dict(gauges)})
+
+
+def evaluate_rules(rules, samples):
+    for rule in rules:
+        if float(rule.threshold_dev) < samples[-1]:        # 9: float/_dev
+            return True
+    return False

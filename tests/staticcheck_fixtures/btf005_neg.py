@@ -13,3 +13,11 @@ def seeded_arrivals(seed, n):
     t0 = time.monotonic()                        # elapsed, not wall
     time.sleep(0.0)
     return times, gen.normal(), time.monotonic() - t0
+
+
+def ring_sample(ring, seq, signals, t_wall=0.0):
+    # the recorder discipline: ordering from seq + monotonic; the wall
+    # stamp is caller-supplied display metadata, never read here
+    ring.append({"seq": seq, "t_mono": time.monotonic(),
+                 "t_wall": t_wall, "signals": signals})
+    return seq + 1

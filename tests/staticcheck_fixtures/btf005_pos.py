@@ -1,8 +1,9 @@
 """BTF005 positive fixture: nondeterminism in trace-feeding code.
 
-Expected findings: 6 — a module-global random draw, an unseeded
+Expected findings: 7 — a module-global random draw, an unseeded
 random.Random(), a wall-clock read, uuid4, os.urandom, and a numpy
-global-state draw.
+global-state draw, plus the ISSUE 16 time-series shape: a ring append
+that stamps its ordering key from the wall clock.
 """
 import os
 import random
@@ -20,3 +21,9 @@ def jittered_arrival(rate):
     salt = os.urandom(8)                     # 5: entropy
     noise = np.random.normal()               # 6: numpy global state
     return dt, rng, t0, rid, salt, noise
+
+
+def ring_sample(ring, signals):
+    # a time-series ring ordered by wall stamps is non-replayable: NTP
+    # steps reorder it (the recorder orders by seq + monotonic instead)
+    ring.append({"t": time.time(), "signals": signals})   # 7: wall clock

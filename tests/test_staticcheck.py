@@ -55,9 +55,9 @@ EXPECTED_RULES = {
 POSITIVE_COUNTS = {
     "BTF001": 3,
     "BTF002": 5,
-    "BTF003": 7,
+    "BTF003": 9,
     "BTF004": 5,
-    "BTF005": 6,
+    "BTF005": 7,
     "BTF006": 3,
 }
 

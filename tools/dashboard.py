@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Render a dumped timeseries body as a static dashboard.
+
+Consumes either shape:
+
+* ``GET /debug/timeseries`` — one replica's SignalRecorder ring
+  (samples carry ``seq``/``t_wall``/``signals``);
+* ``GET /fleet/timeseries`` — the control plane's clock-offset merge
+  (samples additionally carry ``source``/``t_fleet``; rendered as
+  per-source small multiples).
+
+Default output is a self-contained static HTML page — inline SVG
+sparkline per signal, min/mean/max/last stat row, alert annotations
+(vertical markers where an alert rule fired inside the window), and a
+reconciliation footer (observed samples vs the span/interval
+expectation — the honesty line saying how much of the window the ring
+actually covers). ``--text`` renders the same series as unicode
+sparklines for terminals.
+
+stdlib-only (no jax, no numpy): runs anywhere, like tick_report.py.
+
+Usage:  curl -s host:8000/debug/timeseries > ts.json
+        python tools/dashboard.py ts.json --out dash.html
+        python tools/dashboard.py ts.json --text
+        butterfly dash ts.json --text
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+SVG_W, SVG_H, SVG_PAD = 600, 64, 4
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or "samples" not in dump:
+        raise ValueError(
+            f"{path} is not a timeseries dump (expected a JSON object "
+            f"with a 'samples' list — /debug/timeseries or "
+            f"/fleet/timeseries)")
+    return dump
+
+
+def is_fleet(dump: dict) -> bool:
+    if str(dump.get("schema", "")).startswith("butterfly-fleet"):
+        return True
+    return any("source" in s for s in dump.get("samples", ()))
+
+
+def sample_time(s: dict) -> float:
+    """Sample timestamp on the dump's merge clock (fleet dumps carry
+    t_fleet; replica dumps t_wall)."""
+    return float(s.get("t_fleet", s.get("t_wall", 0.0)))
+
+
+def collect(dump: dict) -> Dict[str, Dict[str, List[Tuple[float, float]]]]:
+    """{source: {signal: [(t, v), ...]}}; a replica dump collapses to
+    the single source ''."""
+    out: Dict[str, Dict[str, List[Tuple[float, float]]]] = {}
+    for s in dump.get("samples", ()):
+        src = str(s.get("source", ""))
+        t = sample_time(s)
+        for k, v in s.get("signals", {}).items():
+            out.setdefault(src, {}).setdefault(k, []).append(
+                (t, float(v)))
+    for signals in out.values():
+        for series in signals.values():
+            series.sort(key=lambda p: p[0])
+    return out
+
+
+def stats(series: List[Tuple[float, float]]) -> Dict[str, float]:
+    vals = [v for _, v in series]
+    return {"min": min(vals), "max": max(vals),
+            "mean": sum(vals) / len(vals), "last": vals[-1],
+            "n": len(vals)}
+
+
+def reconciliation(dump: dict) -> Optional[Dict[str, float]]:
+    """Observed sample count vs the span/interval expectation (replica
+    dumps only: the fleet merge mixes cadences)."""
+    samples = dump.get("samples", ())
+    interval = float(dump.get("interval_s") or 0.0)
+    if len(samples) < 2 or interval <= 0:
+        return None
+    span = sample_time(samples[-1]) - sample_time(samples[0])
+    expected = span / interval + 1 if span > 0 else len(samples)
+    return {"samples": len(samples), "span_s": span,
+            "expected": expected,
+            "coverage": len(samples) / expected if expected else 1.0}
+
+
+# -- text rendering -----------------------------------------------------------
+
+def sparkline(vals: List[float], width: int = 48) -> str:
+    if not vals:
+        return ""
+    if len(vals) > width:  # downsample: last value per bucket
+        step = len(vals) / width
+        vals = [vals[min(len(vals) - 1, int((i + 1) * step) - 1)]
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        SPARK_BLOCKS[min(len(SPARK_BLOCKS) - 1,
+                         int((v - lo) / span * len(SPARK_BLOCKS)))]
+        for v in vals)
+
+
+def render_text(dump: dict) -> str:
+    grouped = collect(dump)
+    alerts = list(dump.get("alerts", ()))
+    lines = []
+    kind = "fleet" if is_fleet(dump) else "replica"
+    lines.append(f"{kind} timeseries: "
+                 f"{len(dump.get('samples', ()))} sample(s), "
+                 f"{sum(len(sig) for sig in grouped.values())} series, "
+                 f"{len(alerts)} alert(s)")
+    for src in sorted(grouped):
+        if src:
+            lines.append("")
+            lines.append(f"== {src} ==")
+        for name in sorted(grouped[src]):
+            series = grouped[src][name]
+            st = stats(series)
+            lines.append(
+                f"{name:>28} {sparkline([v for _, v in series])} "
+                f"min {st['min']:g}  mean {st['mean']:g}  "
+                f"max {st['max']:g}  last {st['last']:g}")
+    if alerts:
+        lines.append("")
+        lines.append("alerts:")
+        for a in alerts:
+            src = a.get("source", "")
+            lines.append(f"  [{a.get('severity', '?'):>4}] "
+                         f"{a.get('rule', '?')} on "
+                         f"{a.get('signal', '?')}"
+                         + (f" @ {src}" if src else "")
+                         + f" (value {a.get('value', 0):g})")
+    rec = reconciliation(dump)
+    lines.append("")
+    if rec is not None:
+        lines.append(f"{rec['samples']} samples over "
+                     f"{rec['span_s']:.1f}s at interval "
+                     f"{dump.get('interval_s')}s: "
+                     f"{100 * rec['coverage']:.1f}% of the expected "
+                     f"window covered")
+    else:
+        lines.append("no single-cadence reconciliation "
+                     "(merged or short dump)")
+    return "\n".join(lines)
+
+
+# -- HTML rendering -----------------------------------------------------------
+
+def _svg_sparkline(series: List[Tuple[float, float]],
+                   alert_ts: List[float]) -> str:
+    ts = [t for t, _ in series]
+    vals = [v for _, v in series]
+    t0, t1 = min(ts), max(ts)
+    lo, hi = min(vals), max(vals)
+    tspan = (t1 - t0) or 1.0
+    vspan = (hi - lo) or 1.0
+    w, h, pad = SVG_W, SVG_H, SVG_PAD
+
+    def x(t: float) -> float:
+        return pad + (t - t0) / tspan * (w - 2 * pad)
+
+    def y(v: float) -> float:
+        return h - pad - (v - lo) / vspan * (h - 2 * pad)
+
+    pts = " ".join(f"{x(t):.1f},{y(v):.1f}" for t, v in series)
+    marks = "".join(
+        f'<line x1="{x(t):.1f}" y1="0" x2="{x(t):.1f}" y2="{h}" '
+        f'class="alert"/>' for t in alert_ts if t0 <= t <= t1)
+    return (f'<svg width="{w}" height="{h}" viewBox="0 0 {w} {h}">'
+            f'{marks}<polyline points="{pts}" fill="none" '
+            f'class="line"/></svg>')
+
+
+_CSS = """
+body { font: 13px/1.5 system-ui, sans-serif; margin: 2em auto;
+       max-width: 72em; color: #222; }
+h1 { font-size: 1.3em; } h2 { font-size: 1.1em; margin-top: 1.6em; }
+table.signals td { padding: 2px 10px; vertical-align: middle; }
+td.name { font-family: ui-monospace, monospace; text-align: right; }
+td.stat { font-family: ui-monospace, monospace; color: #555;
+          white-space: nowrap; }
+svg .line { stroke: #2061c4; stroke-width: 1.5; }
+svg .alert { stroke: #d43a2f; stroke-width: 1; }
+ul.alerts li { font-family: ui-monospace, monospace; }
+.sev-page { color: #d43a2f; font-weight: bold; }
+.sev-warn { color: #b07a00; font-weight: bold; }
+footer { margin-top: 2em; color: #777; }
+"""
+
+
+def render_html(dump: dict) -> str:
+    grouped = collect(dump)
+    alerts = list(dump.get("alerts", ()))
+    kind = "fleet" if is_fleet(dump) else "replica"
+    out = ["<!doctype html><html><head><meta charset='utf-8'>",
+           f"<title>butterfly {kind} timeseries</title>",
+           f"<style>{_CSS}</style></head><body>",
+           f"<h1>butterfly {kind} timeseries</h1>",
+           f"<p>{len(dump.get('samples', ()))} sample(s) &middot; "
+           f"{len(alerts)} alert(s) &middot; schema "
+           f"{html.escape(str(dump.get('schema', '?')))}</p>"]
+    for src in sorted(grouped):
+        if src:
+            out.append(f"<h2>{html.escape(src)}</h2>")
+        out.append("<table class='signals'>")
+        for name in sorted(grouped[src]):
+            series = grouped[src][name]
+            st = stats(series)
+            alert_ts = [float(a.get("t_fleet", a.get("t_wall", 0.0)))
+                        for a in alerts
+                        if a.get("signal") == name
+                        and (not src
+                             or str(a.get("source", "")) in
+                             (src, src.replace("scrape:", "")))]
+            out.append(
+                "<tr>"
+                f"<td class='name'>{html.escape(name)}</td>"
+                f"<td>{_svg_sparkline(series, alert_ts)}</td>"
+                f"<td class='stat'>min {st['min']:g}<br>"
+                f"mean {st['mean']:g}</td>"
+                f"<td class='stat'>max {st['max']:g}<br>"
+                f"last {st['last']:g}</td></tr>")
+        out.append("</table>")
+    if alerts:
+        out.append("<h2>alerts</h2><ul class='alerts'>")
+        for a in alerts:
+            sev = html.escape(str(a.get("severity", "?")))
+            src = html.escape(str(a.get("source", "")))
+            out.append(
+                f"<li><span class='sev-{sev}'>[{sev}]</span> "
+                f"{html.escape(str(a.get('rule', '?')))} on "
+                f"{html.escape(str(a.get('signal', '?')))}"
+                + (f" @ {src}" if src else "")
+                + f" &mdash; value {a.get('value', 0):g}, "
+                f"window {a.get('window', '?')}</li>")
+        out.append("</ul>")
+    rec = reconciliation(dump)
+    if rec is not None:
+        out.append(f"<footer>{rec['samples']} samples over "
+                   f"{rec['span_s']:.1f}s at interval "
+                   f"{dump.get('interval_s')}s &mdash; "
+                   f"{100 * rec['coverage']:.1f}% of the expected "
+                   f"window covered</footer>")
+    else:
+        out.append("<footer>no single-cadence reconciliation "
+                   "(merged or short dump)</footer>")
+    out.append("</body></html>")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a dumped /debug/timeseries or "
+                    "/fleet/timeseries body as a dashboard")
+    ap.add_argument("dump", help="JSON file (the timeseries body)")
+    ap.add_argument("--out", help="write HTML here (default: stdout)")
+    ap.add_argument("--text", action="store_true",
+                    help="unicode sparklines for terminals instead "
+                         "of HTML")
+    args = ap.parse_args(argv)
+    try:
+        dump = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    body = render_text(dump) if args.text else render_html(dump)
+    if args.out and not args.text:
+        with open(args.out, "w") as f:
+            f.write(body)
+        print(f"wrote {args.out}")
+    else:
+        print(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,6 +205,14 @@ MUTANTS = [
      "if burn >= self.slo_burn_threshold and burn > 0.0:",
      'if burn >= float("inf") and burn > 0.0:',
      ["tests/test_obs.py"], {}),
+    # alert rules (ISSUE 16): collapse the sustained-window guard so a
+    # rule fires on a SINGLE above-threshold sample — every transient
+    # blip would page. Killed by the alert-rule unit tests (one hot
+    # sample must NOT fire; a full window must).
+    ("butterfly_tpu/obs/timeseries.py",
+     "if len(tail) < rule.window:",
+     "if len(tail) < 1:",
+     ["tests/test_timeseries.py"], {}),
     # workload generator: the Poisson arrival process ignores its rate
     # (every open-loop bench/sweep would silently offer ~1 req/s
     # regardless of the requested load) — the arrival-statistics test

@@ -10,8 +10,9 @@ breaks replay while every test still passes on its own machine. The
 chaos plan carries the same contract (same plan + seed + call sequence
 => identical injections, PR 8).
 
-Flags, in the trace-feeding scope (workload/, fleet/chaos.py, and the
-loadgen/replay tooling):
+Flags, in the trace-feeding scope (workload/, fleet/chaos.py, the
+loadgen/replay tooling, and the obs time-series ring, whose ordering
+contract is seq + monotonic only — wall stamps are caller-supplied):
 
 * module-global PRNG draws: ``random.<fn>()`` for any fn except the
   ``Random``/``SystemRandom`` constructors; ``np.random.<fn>()`` except
@@ -42,7 +43,7 @@ class DeterminismRule(Rule):
     invariant = ("trace-feeding code draws only from seeded generators "
                  "and never reads the wall clock")
     scope = ("butterfly_tpu/workload", "butterfly_tpu/fleet/chaos.py",
-             "tools/loadgen.py")
+             "tools/loadgen.py", "butterfly_tpu/obs/timeseries.py")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

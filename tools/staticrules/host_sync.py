@@ -43,6 +43,9 @@ HOT_FUNCTIONS: Set[str] = {
     "prefill_batch", "_sync_table",
     "_phase_add", "_drain_accrued", "_record_tick",
     "record", "note", "poll",
+    # ISSUE 16: the signal recorder samples inside _record_tick (the
+    # tail of the hot section) — it must consume host floats only
+    "sample", "evaluate_rules",
 }
 
 #: conventional device-resident value names in the hot path (plus any
@@ -94,7 +97,8 @@ class HostSyncRule(Rule):
                  "stacked drain)")
     scope = ("butterfly_tpu/engine/serving.py",
              "butterfly_tpu/sched/scheduler.py",
-             "butterfly_tpu/obs/ticklog.py")
+             "butterfly_tpu/obs/ticklog.py",
+             "butterfly_tpu/obs/timeseries.py")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

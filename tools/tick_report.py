@@ -11,12 +11,20 @@ stdlib-only (no jax, no numpy): runs anywhere, like trace_report.py.
 
 Usage:  curl -s host:8000/debug/ticks > ticks.json
         python tools/tick_report.py ticks.json [--json]
+        python tools/tick_report.py http://host:8000 --follow
+
+``--follow`` polls ``GET /debug/ticks?since=<seq>`` incrementally —
+each poll fetches only the ticks recorded since the last one (the
+seq-paged ring contract) and renders them one line per tick, so a live
+TPU sitting watches the tick anatomy without repeated full dumps.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import time
+import urllib.request
 from typing import Dict, List
 
 
@@ -106,13 +114,69 @@ def render(dump: dict) -> str:
     return "\n".join(lines)
 
 
+def tick_line(t: dict) -> str:
+    """One incremental --follow line per tick: seq, wall, the dominant
+    phase of THIS tick, pipeline depth, occupancy, page headroom."""
+    phases = t.get("phases", {})
+    timed = {k: v for k, v in phases.items() if k != "other"}
+    dom = max(timed, key=timed.get) if timed else "-"
+    causes = ",".join(t.get("barrier_causes", ())) or "-"
+    return (f"tick {t.get('seq', '?'):>7} {t.get('wall_s', 0.0):>9.4f}s "
+            f"dom={dom}:{timed.get(dom, 0.0):.4f}s "
+            f"fetch={t.get('fetch_s', 0.0):.4f}s "
+            f"batch={t.get('batch', 0)} wait={t.get('waiting', 0)} "
+            f"inflight={t.get('inflight', 0)} "
+            f"pages={t.get('pages_free', 0)} "
+            f"gen={t.get('generated', 0)} barriers={causes}")
+
+
+def follow(url: str, interval: float, timeout: float,
+           max_polls: int = 0) -> int:
+    """Poll GET /debug/ticks?since=<seq> and render new ticks as they
+    land. `max_polls` bounds the loop for scripted runs (0 = forever).
+    """
+    base = url.rstrip("/")
+    since = 0
+    polls = 0
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"{base}/debug/ticks?since={since}",
+                    timeout=timeout) as resp:
+                dump = json.loads(resp.read() or b"{}")
+        except Exception as e:  # server restarting: report, keep polling
+            print(f"poll error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            dump = {}
+        for t in dump.get("ticks", ()):
+            print(tick_line(t), flush=True)
+        since = max(since, int(dump.get("next_seq", since)))
+        polls += 1
+        if max_polls and polls >= max_polls:
+            return 0
+        time.sleep(interval)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render a dumped GET /debug/ticks body")
-    ap.add_argument("dump", help="JSON file (the /debug/ticks body)")
+    ap.add_argument("dump", help="JSON file (the /debug/ticks body), "
+                                 "or the server base URL with --follow")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable aggregate instead of the table")
+    ap.add_argument("--follow", action="store_true",
+                    help="poll /debug/ticks?since=seq incrementally "
+                         "(dump is the base URL, e.g. http://host:8000)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll interval in seconds")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="--follow per-poll HTTP timeout in seconds")
+    ap.add_argument("--max-polls", type=int, default=0,
+                    help="--follow: stop after N polls (0 = forever)")
     args = ap.parse_args(argv)
+    if args.follow:
+        return follow(args.dump, args.interval, args.timeout,
+                      max_polls=args.max_polls)
     try:
         dump = load_dump(args.dump)
     except (OSError, ValueError, json.JSONDecodeError) as e:
