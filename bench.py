@@ -55,7 +55,8 @@ def main() -> int:
     import jax
     from butterfly_tpu.core.config import llama3_8b, tiny
     from butterfly_tpu.models.common import Model
-    from butterfly_tpu.obs.benchmark import (run_chaos_benchmark,
+    from butterfly_tpu.obs.benchmark import (run_autoscale_benchmark,
+                                             run_chaos_benchmark,
                                              run_decode_benchmark,
                                              run_fleet_benchmark,
                                              run_mixed_benchmark,
@@ -199,10 +200,14 @@ def main() -> int:
         # ~18 pages/request, so 32 contested slots (~576 pages) overrun
         # the ~390-page pool while the largest single request (81
         # pages) still fits — preemption measured, not configured away
+        # host KV tier (ISSUE 17): the contested pool above evicts
+        # shared-prefix chains mid-run; a 64 MB host tier turns those
+        # into demotions that revive on the cohorts' next admission —
+        # kv_tier_hit_rate/restore latency measured under real pressure
         mixed_kw = dict(n_requests=64, max_batch=32,
                         prompt_lo=32, prompt_hi=1024,
                         max_new_lo=16, max_new_hi=256, page_size=16,
-                        pool_fraction=0.15,
+                        pool_fraction=0.15, host_kv_tier_mb=64.0,
                         decode_steps_per_tick=16, inflight_blocks=2,
                         prefill_max_batch=16, kv_quant="int8",
                         grid=[(4, 1), (4, 2), (16, 1), (16, 2)])
@@ -215,7 +220,7 @@ def main() -> int:
         mixed_kw = dict(n_requests=12, max_batch=4,
                         prompt_lo=8, prompt_hi=48,
                         max_new_lo=16, max_new_hi=48, page_size=8,
-                        pool_fraction=0.35,
+                        pool_fraction=0.35, host_kv_tier_mb=8.0,
                         arrival="burst:2000:0.5:0.1",
                         decode_steps_per_tick=4, inflight_blocks=2,
                         prefill_max_batch=4, kv_quant="none",
@@ -265,6 +270,14 @@ def main() -> int:
     # drops (chaos_unterminal/chaos_errors == 0 when healthy).
     chaos = run_chaos_benchmark("2p2d")
     for k, v in chaos.items():
+        out[k] = round(v, 4) if isinstance(v, float) else v
+    # Elastic tier (ISSUE 17): a ramp arrival against a 1-decode floor
+    # with the closed-loop autoscaler governing the decode tier.
+    # Carries SLO attainment, the replica-seconds integral vs the
+    # static peak shape (the saving the loop exists to buy), and the
+    # flight-recorder scale-event audit count.
+    autoscale = run_autoscale_benchmark("1p1d")
+    for k, v in autoscale.items():
         out[k] = round(v, 4) if isinstance(v, float) else v
     print(json.dumps(out))
     return 0

@@ -88,6 +88,22 @@ class PrefixCachingAllocator(PageAllocator):
         self._evictable: "OrderedDict[int, None]" = OrderedDict()  # LRU
         self.hit_tokens = 0      # stats: prompt tokens served from cache
         self.lookup_tokens = 0   # stats: prompt tokens looked up
+        # Host-tier hooks (cache/hosttier.py wiring), opt-in with the
+        # scheduler's attribute-is-None contract:
+        # * on_evict(chain_digest, page_id) fires as a registered page
+        #   is recycled, AFTER deregistration and BEFORE the page id
+        #   returns to the free list — the one moment the device bytes
+        #   are both stable (registered pages are content-immutable)
+        #   and about to be lost. The hook must not re-enter this
+        #   allocator; failures are swallowed (the tier is best-effort
+        #   — losing a demotion costs a future prefill, never
+        #   correctness).
+        # * reviver(chain_digest) -> page_id|None fires on a registry
+        #   miss during admission's prefix walk: a tier hit claims a
+        #   page via import_page, lands the bytes, and returns the page
+        #   id so the walk continues as if the page had stayed warm.
+        self.on_evict = None
+        self.reviver = None
 
     # -- queries ------------------------------------------------------------
 
@@ -107,6 +123,11 @@ class PrefixCachingAllocator(PageAllocator):
         h = self._page_hash.pop(pid)
         del self._entries[h]
         del self._ref[pid]
+        if self.on_evict is not None:
+            try:
+                self.on_evict(h, pid)
+            except Exception:
+                pass  # demotion is best-effort; eviction must proceed
         self._free.append(pid)
 
     def _take_free(self) -> int:
@@ -141,13 +162,20 @@ class PrefixCachingAllocator(PageAllocator):
         matched: List[int] = []
         for h in self._chain_hashes(tokens, matchable):
             pid = self._entries.get(h)
+            if pid is None and self.reviver is not None:
+                # registry miss: give the host tier a chance to revive
+                # the chain's next page (import_page + a device write on
+                # the scheduler side). The revive may itself evict — the
+                # inline incref below is what keeps THIS chain's earlier
+                # matches off the evictable list while that happens.
+                pid = self.reviver(h)
             if pid is None:
                 break
-            matched.append(pid)
-        # incref BEFORE counting availability: a matched page may sit in
-        # the evictable list, and it must count as held, not as free.
-        for pid in matched:
+            # incref BEFORE counting availability: a matched page may
+            # sit in the evictable list, and it must count as held, not
+            # as free.
             self._incref(pid)
+            matched.append(pid)
         want = -(-need_len // ps) - len(matched)
         if want > len(self._free) + len(self._evictable):
             for pid in matched:  # rollback, nothing allocated

@@ -250,6 +250,23 @@ class RuntimeConfig:
                                       # per-token write_paged_layer path.
                                       # Ignored (per-token writes) under
                                       # pipeline (stage>1) serving
+    host_kv_tier_mb: float = 0.0      # host-RAM KV tier capacity in MB
+                                      # (cache/hosttier.py): > 0 turns
+                                      # prefix-cache eviction into
+                                      # evict-to-host — recycled pages
+                                      # park their bytes in host DRAM
+                                      # keyed by chain digest and revive
+                                      # on the next prefix hit instead
+                                      # of re-prefilling. Requires
+                                      # prefix_caching; 0 = off (drop
+                                      # on evict, the pre-tier behavior)
+    host_kv_tier_dir: Optional[str] = None
+                                      # optional disk-spill directory
+                                      # for the host tier: pages LRU'd
+                                      # out of the RAM budget demote to
+                                      # one .npz each instead of being
+                                      # dropped, and promote back on
+                                      # access. None = RAM only
     decode_window: int = 0            # fused-generate write combining:
                                       # decode this many tokens into a
                                       # small window, flush to the cache

@@ -411,7 +411,11 @@ class ControlPlaneState(RouterState):
                         # device-bound per replica — an autoscaler that
                         # only sees queue depth can't tell which tier
                         # needs more replicas vs a faster host path
-                        "tick_host_frac", "tick_phase_dominant_p95")
+                        "tick_host_frac", "tick_phase_dominant_p95",
+                        # host KV tier (ISSUE 17): revive economics per
+                        # replica — absent on tier-less replicas (the
+                        # re-export skips absent gauges)
+                        "kv_tier_hit_rate")
 
     #: consecutive failed /metrics scrapes after which a replica's
     #: re-exported gauges are DROPPED from /fleet/metrics: a gauge
@@ -597,6 +601,11 @@ def make_fleet_handler(state: ControlPlaneState):
                 self._fleet_trace()
             elif path == "/fleet/flightrecorder":
                 self._json(200, state.flightrecorder_rollup())
+            elif path == "/debug/flightrecorder":
+                # the control plane's OWN ring (breaker opens, deadline
+                # 504s, autoscaler scale/scale_held decisions) — same
+                # shape a replica serves under this path
+                self._json(200, state.flightrec.dump())
             elif path == "/fleet/timeseries":
                 self._json(200, state.fleet_timeseries())
             elif path == "/fleet/metrics":

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from http.server import ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
@@ -32,18 +33,19 @@ from butterfly_tpu.router.policy import PrefixAffinityPolicy
 from butterfly_tpu.router.pool import ReplicaPool
 
 
-def parse_topology(spec: str) -> Tuple[int, int]:
-    """'2p2d' -> (2 prefill, 2 decode); '1p1d', '3p1d', ... Also
-    accepts '4' as shorthand for a role-less 4x'both' pool (0p0d would
-    be meaningless)."""
+def parse_topology(spec: str) -> List[str]:
+    """Topology spec -> per-replica role list. Arbitrary 'NpMd' shapes
+    ('2p2d', '3p5d', '0p4d' — a zero side means that tier starts empty,
+    the elastic-fleet starting shapes; '0p0d' is meaningless) plus the
+    bare-digit shorthand '4' for a role-less 4x'both' pool."""
     m = re.fullmatch(r"(\d+)p(\d+)d", spec.strip().lower())
     if m:
         n_pre, n_dec = int(m.group(1)), int(m.group(2))
-        if n_pre < 1 or n_dec < 1:
-            raise ValueError(f"topology {spec!r} needs >=1 replica per tier")
-        return n_pre, n_dec
+        if n_pre + n_dec < 1:
+            raise ValueError(f"topology {spec!r} needs >=1 replica")
+        return ["prefill"] * n_pre + ["decode"] * n_dec
     if spec.strip().isdigit() and int(spec) >= 1:
-        return 0, int(spec)  # all-'both' pool
+        return ["both"] * int(spec)  # role-less pool
     raise ValueError(f"unparseable topology {spec!r} (want e.g. '2p2d')")
 
 
@@ -82,16 +84,86 @@ class ReplicaHandle:
 
 
 class FleetHandle:
-    def __init__(self, replicas: List[ReplicaHandle], cp_state, cp_httpd):
+    def __init__(self, replicas: List[ReplicaHandle], cp_state, cp_httpd,
+                 spawn_ctx: Optional[dict] = None):
         self.replicas = replicas
         self.state = cp_state
         self.httpd = cp_httpd
         self.url = f"http://127.0.0.1:{cp_httpd.server_port}"
         self.by_rid = {r.rid: r for r in replicas}
+        # runtime spawn context (model + shared param tree + replica
+        # kwargs) captured by start_fleet: what makes a spawned
+        # replica's KV bytes interchangeable with the incumbents'
+        self._spawn_ctx = spawn_ctx
+        self._lock = threading.Lock()
+        self._tier_index: dict = {}
+        for r in replicas:
+            self._tier_index[r.role] = self._tier_index.get(r.role, 0) + 1
 
     @property
     def rids(self) -> List[str]:
         return [r.rid for r in self.replicas]
+
+    def spawn(self, role: str) -> ReplicaHandle:
+        """Grow one tier at runtime: start a replica on the SHARED
+        param tree, warm it (start_replica warms BEFORE its HTTP front
+        binds — warm-before-join is structural, a joining replica can
+        never serve a compile-cold request), then attach it to the
+        pool, probe it so its role is known before anything routes,
+        and remap the affinity ring."""
+        if self._spawn_ctx is None:
+            raise RuntimeError("this fleet was started without a spawn "
+                               "context (start_fleet builds one)")
+        with self._lock:
+            idx = self._tier_index.get(role, 0)
+            self._tier_index[role] = idx + 1
+        ctx = self._spawn_ctx
+        handle = start_replica(ctx["model"], ctx["params"], role,
+                               chaos_index=idx, **ctx["replica_kw"])
+        pool = self.state.pool
+        pool.add(handle.rid)
+        rep = pool.get(handle.rid)
+        if rep is not None:
+            pool.probe_one(rep)  # learn role/load before routing
+        self.state.policy.rebuild_ring()
+        with self._lock:
+            self.replicas.append(handle)
+            self.by_rid[handle.rid] = handle
+        return handle
+
+    def retire(self, rid: str, timeout: float = 30.0) -> bool:
+        """Shrink a tier at runtime, drain-before-retire: mark the
+        member draining (no NEW requests route to it), wait for its
+        proxied legs AND its own queue/runners to empty, then stop its
+        front, detach it from the pool, and remap the affinity ring.
+        On timeout the replica is retired anyway — bounded shrink beats
+        a wedged runner pinning capacity forever. False if unknown."""
+        handle = self.by_rid.get(rid)
+        if handle is None:
+            return False
+        pool = self.state.pool
+        if len(pool.replicas) <= 1:
+            raise ValueError("cannot retire the last replica")
+        pool.set_drain(rid, True)
+        deadline = time.monotonic() + timeout
+        sched = handle.sched
+        while time.monotonic() < deadline:
+            rep = pool.get(rid)
+            outstanding = rep.outstanding if rep is not None else 0
+            # cross-thread reads of the scheduler's queues are racy but
+            # monotone-enough for a drain check: a request in flight is
+            # visible in at least one of these until its finish callback
+            if outstanding == 0 and not sched.waiting \
+                    and not sched.running and not sched._prefill_group:
+                break
+            time.sleep(0.02)
+        handle.stop()
+        pool.remove(rid)
+        self.state.policy.rebuild_ring()
+        with self._lock:
+            self.replicas.remove(handle)
+            self.by_rid.pop(rid, None)
+        return True
 
     def stop(self) -> None:
         self.state.pool.stop()
@@ -108,6 +180,8 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
                   warm_len: Optional[int] = None,
                   slo_ttft_s: Optional[float] = None,
                   slo_itl_s: Optional[float] = None,
+                  host_kv_tier_mb: float = 0.0,
+                  host_kv_tier_dir: Optional[str] = None,
                   chaos=None, chaos_index: int = 0) -> ReplicaHandle:
     """One in-process serve replica on a fresh loopback port. Prefix
     caching is always on — it is the registry KV transfer addresses
@@ -129,7 +203,9 @@ def start_replica(model, params, role: str, *, page_size: int = 8,
 
     rt = RuntimeConfig(max_batch_size=max_batch, max_seq_len=max_seq,
                        page_size=page_size, num_pages=num_pages,
-                       prefix_caching=True)
+                       prefix_caching=True,
+                       host_kv_tier_mb=host_kv_tier_mb,
+                       host_kv_tier_dir=host_kv_tier_dir)
     # flight recorder always on, like tracing: the fleet rollup
     # (GET /fleet/flightrecorder) merges every replica's ring
     sched = Scheduler(ServingEngine(model, params, rt), tracer=Tracer(),
@@ -173,6 +249,8 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
                 warm_len: Optional[int] = None,
                 slo_ttft_s: Optional[float] = None,
                 slo_itl_s: Optional[float] = None,
+                host_kv_tier_mb: float = 0.0,
+                host_kv_tier_dir: Optional[str] = None,
                 chaos=None) -> FleetHandle:
     """Spin the whole topology: replicas (one shared tiny-model param
     tree unless the caller provides model+params) + control plane, and
@@ -183,27 +261,24 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
     import jax
     from butterfly_tpu.models.common import Model
 
-    n_pre, n_dec = parse_topology(topology)
+    roles = parse_topology(topology)
     if model is None:
         model = Model(tiny("llama", dtype="float32", param_dtype="float32"))
         # btf: disable=BTF006 replicas must share one identical param tree (KV bytes interchangeable)
         params = model.init(jax.random.PRNGKey(0))
-    roles = ["prefill"] * n_pre + ["decode"] * n_dec
-    if not roles:
-        raise ValueError("empty topology")
-    if n_pre == 0:  # '4' shorthand: a role-less pool
-        roles = ["both"] * n_dec
+    replica_kw = dict(page_size=page_size, max_batch=max_batch,
+                      max_seq=max_seq, num_pages=num_pages, warm=warm,
+                      warm_len=warm_len, slo_ttft_s=slo_ttft_s,
+                      slo_itl_s=slo_itl_s,
+                      host_kv_tier_mb=host_kv_tier_mb,
+                      host_kv_tier_dir=host_kv_tier_dir, chaos=chaos)
     tier_index: dict = {}
     replicas = []
     for role in roles:
         idx = tier_index.get(role, 0)
         tier_index[role] = idx + 1
         replicas.append(start_replica(
-            model, params, role, page_size=page_size,
-            max_batch=max_batch, max_seq=max_seq,
-            num_pages=num_pages, warm=warm,
-            warm_len=warm_len, slo_ttft_s=slo_ttft_s,
-            slo_itl_s=slo_itl_s, chaos=chaos, chaos_index=idx))
+            model, params, role, chaos_index=idx, **replica_kw))
     registry = MetricsRegistry()
     pool = ReplicaPool([r.rid for r in replicas],
                        probe_interval=probe_interval, registry=registry,
@@ -221,4 +296,5 @@ def start_fleet(topology: str = "2p2d", *, page_size: int = 8,
     cp_httpd = ThreadingHTTPServer(("127.0.0.1", 0),
                                    make_fleet_handler(cp_state))
     threading.Thread(target=cp_httpd.serve_forever, daemon=True).start()
-    return FleetHandle(replicas, cp_state, cp_httpd)
+    spawn_ctx = {"model": model, "params": params, "replica_kw": replica_kw}
+    return FleetHandle(replicas, cp_state, cp_httpd, spawn_ctx=spawn_ctx)

@@ -10,8 +10,11 @@ plane's disaggregated prefill/decode handoff (fleet/controlplane.py):
   on the LOCAL registry, pin the matched leading run against eviction,
   read the page contents to the host in one gather, unpin, and return
   a JSON-safe payload (base64 page bytes + dtype/shape metadata +
-  geometry). Hashes past the first miss are reported ``missing`` —
-  pages behind a gap could never be attached by ``admit`` anyway.
+  geometry). When the replica carries a host KV tier
+  (cache/hosttier.py) the leading run continues from it where the
+  device registry misses — evicted chains stay exportable. Hashes past
+  the first miss of BOTH tiers are reported ``missing`` — pages behind
+  a gap could never be attached by ``admit`` anyway.
 * ``import_payload(sched, payload)`` — validate geometry (page size,
   layer/head/dim counts, dtype, quantization MUST match; a mismatched
   import would alias garbage K/V under a valid-looking hash), claim
@@ -83,32 +86,54 @@ def export_payload(sched, hex_hashes: List[str]) -> Dict:
         if pid is None:
             break
         matched.append(pid)
+    # continue the leading run from the host tier (cache/hosttier.py):
+    # a chain this replica evicted to host DRAM is still exportable —
+    # the bytes are already host-resident, so no device read is needed
+    # for the continuation. Chain contiguity holds: the tier pages
+    # start exactly where the device registry missed.
+    tier = getattr(sched, "host_tier", None)
+    tier_pages: List[tuple] = []
+    if tier is not None:
+        for i in range(len(matched), len(hashes)):
+            data = tier.load(hashes[i])
+            if data is None:
+                break
+            tier_pages.append((hex_hashes[i], data))
     payload: Dict = {
         "version": PAYLOAD_VERSION,
         "meta": _geometry(sched),
         "pages": [],
-        "missing": hex_hashes[len(matched):],
+        "missing": hex_hashes[len(matched) + len(tier_pages):],
         "bytes": 0,
     }
-    if not matched:
+    if not matched and not tier_pages:
         return payload
-    # pin the whole run before any device read: the gather below may
-    # release the GIL, and an admission on the scheduler thread (once
-    # the lock is handed back between chunked exports) must never
-    # recycle a page mid-transfer
-    alloc.pin(matched)
-    try:
-        k, v, ks, vs = sched.engine.read_pages(matched)
-    finally:
-        alloc.unpin(matched)
     total = 0
-    for i, h in enumerate(hex_hashes[:len(matched)]):
-        entry = {"hash": h, "k": _enc(k[:, i]), "v": _enc(v[:, i])}
-        total += k[:, i].nbytes + v[:, i].nbytes
-        if ks is not None:
-            entry["k_scale"] = _enc(ks[:, i])
-            entry["v_scale"] = _enc(vs[:, i])
-            total += ks[:, i].nbytes + vs[:, i].nbytes
+    if matched:
+        # pin the whole run before any device read: the gather below
+        # may release the GIL, and an admission on the scheduler thread
+        # (once the lock is handed back between chunked exports) must
+        # never recycle a page mid-transfer
+        alloc.pin(matched)
+        try:
+            k, v, ks, vs = sched.engine.read_pages(matched)
+        finally:
+            alloc.unpin(matched)
+        for i, h in enumerate(hex_hashes[:len(matched)]):
+            entry = {"hash": h, "k": _enc(k[:, i]), "v": _enc(v[:, i])}
+            total += k[:, i].nbytes + v[:, i].nbytes
+            if ks is not None:
+                entry["k_scale"] = _enc(ks[:, i])
+                entry["v_scale"] = _enc(vs[:, i])
+                total += ks[:, i].nbytes + vs[:, i].nbytes
+            payload["pages"].append(entry)
+    for h, (k1, v1, ks1, vs1) in tier_pages:
+        entry = {"hash": h, "k": _enc(k1), "v": _enc(v1)}
+        total += k1.nbytes + v1.nbytes
+        if ks1 is not None:
+            entry["k_scale"] = _enc(ks1)
+            entry["v_scale"] = _enc(vs1)
+            total += ks1.nbytes + vs1.nbytes
         payload["pages"].append(entry)
     payload["bytes"] = total
     return payload

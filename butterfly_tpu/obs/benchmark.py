@@ -554,6 +554,7 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
                         slo_ttft_ms: Optional[float] = 1000.0,
                         deadline_ms: Optional[float] = 30000.0,
                         arrival: Optional[str] = None,
+                        host_kv_tier_mb: float = 0.0,
                         seed: int = 0,
                         max_seconds: float = 900.0) -> Dict:
     """Mixed-workload serving phase (ISSUE 10): the canned
@@ -615,6 +616,7 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
                             decode_steps_per_tick=decode_steps_per_tick,
                             inflight_blocks=inflight_blocks,
                             prefix_caching=True,
+                            host_kv_tier_mb=host_kv_tier_mb,
                             prefill_flash_warm=prefill_flash_warm)
     if prefill_max_batch is not None:
         base_rt = base_rt.replace(prefill_max_batch=prefill_max_batch)
@@ -685,6 +687,17 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
             out["mixed_" + k] = r(mm[k])
     out["mixed_drain_barriers_by_cause"] = {
         c: v for c, v in sched.barrier_causes().items() if v}
+    # host KV tier (ISSUE 17): under the deliberately starved pool,
+    # evictions demote to host RAM and prefix hits revive — the tier's
+    # hit-rate / restore-latency economics under real contention
+    if host_kv_tier_mb > 0:
+        out["mixed_host_kv_tier_mb"] = host_kv_tier_mb
+        for k in ("kv_tier_hit_rate", "kv_tier_pages_saved_total",
+                  "kv_tier_pages_restored_total", "kv_tier_misses_total",
+                  "kv_tier_spills_total", "kv_tier_restore_seconds_p50",
+                  "kv_tier_restore_seconds_p95"):
+            if k in mm:
+                out[k] = r(mm[k])
     # signal-history summary over the contested window: the preemption
     # and pages-free series here are the ones that actually move (the
     # acceptance evidence that the time-series ring sees contention)
@@ -717,6 +730,8 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
                         prefix_share: float = 0.5,
                         slo_ttft_ms: float = 2000.0,
                         slo_itl_ms: float = 500.0,
+                        arrival: Optional[str] = None,
+                        host_kv_tier_mb: float = 0.0,
                         seed: int = 0) -> Dict:
     """Fleet soak benchmark: an in-process disaggregated topology
     (fleet/harness.py — tiny model always: the fleet numbers measure
@@ -744,6 +759,7 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
                         disagg_threshold=disagg_threshold,
                         slo_ttft_s=slo_ttft_ms / 1e3,
                         slo_itl_s=slo_itl_ms / 1e3,
+                        host_kv_tier_mb=host_kv_tier_mb,
                         # warm at the workload's prompt length so phase
                         # 1 (the before-TTFT) doesn't eat the XLA
                         # compile for the workload's prefill bucket
@@ -765,12 +781,34 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
             tail_len=tail, max_tokens=max_tokens, seed=seed + 1,
             replicas=fleet.rids,
             restart_hook=lambda rid: fleet.by_rid[rid].restart(),
-            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
+            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms,
+            arrival=arrival)
+        tier = {}
+        if host_kv_tier_mb > 0:
+            for r in fleet.replicas:
+                for k, v in r.sched.metrics().items():
+                    if k.startswith("kv_tier_"):
+                        tier[k] = tier.get(k, 0.0) + v
+            # hit rate and restore percentiles don't sum across
+            # replicas: re-derive the rate, keep the worst percentiles
+            lookups = tier.get("kv_tier_pages_restored_total", 0.0) \
+                + tier.get("kv_tier_misses_total", 0.0)
+            tier["kv_tier_hit_rate"] = round(
+                tier.get("kv_tier_pages_restored_total", 0.0) / lookups
+                if lookups else 0.0, 4)
+            for pk in ("kv_tier_restore_seconds_p50",
+                       "kv_tier_restore_seconds_p95"):
+                vals = [r.sched.metrics().get(pk) for r in fleet.replicas]
+                vals = [v for v in vals if v is not None]
+                if vals:
+                    tier[pk] = round(max(vals), 6)
     finally:
         fleet.stop()
     fm = soak.get("fleet_metrics", {})
     return {
         "fleet_topology": topology,
+        "fleet_arrival": arrival,
+        **tier,
         "fleet_requests": soak["sent"],
         "fleet_dropped": soak["failed"],
         "fleet_outcomes": soak.get("outcomes", {}),
@@ -789,6 +827,116 @@ def run_fleet_benchmark(topology: str = "2p2d", *, clients: int = 3,
         "fleet_slo_ttft_ms": slo_ttft_ms,
         "fleet_slo_itl_ms": slo_itl_ms,
         "fleet_slo_attainment": soak.get("slo_attainment"),
+    }
+
+
+def run_autoscale_benchmark(topology: str = "1p1d", *, clients: int = 4,
+                            requests_per_client: int = 6,
+                            max_tokens: int = 8, page_size: int = 8,
+                            max_batch: int = 2,
+                            arrival: str = "ramp:2:16:4",
+                            slo_ttft_ms: float = 10000.0,
+                            slo_itl_ms: float = 2000.0,
+                            max_decode: int = 3,
+                            signal_high: float = 0.5,
+                            signal_low: float = 0.05,
+                            cooldown_down_s: float = 1.0,
+                            settle_s: float = 6.0,
+                            seed: int = 0) -> Dict:
+    """Elastic-fleet acceptance soak (ISSUE 17): a ramp-arrival open
+    loop against a small in-process fleet WITH the closed-loop
+    autoscaler live on the decode tier. The claim under test: the
+    autoscaler holds the soak's client-measured slo_attainment while
+    spending FEWER replica-seconds than a fleet statically provisioned
+    at the peak shape it reached — elasticity pays for itself.
+
+    The ramp (``ramp:2:16:4`` — 2 -> 16 req/s over 4s, then hold) is
+    the canonical shape: the fleet starts small and correct for the
+    head of the ramp, the scraped queue-depth rings rise with the
+    offered rate, and the loop must grow the decode tier mid-soak.
+    After the load ends a settle window lets the hysteresis-guarded
+    scale-down fire, demonstrating both directions in one run. Every
+    decision lands in the control plane's flight recorder, fetched
+    over HTTP from /debug/flightrecorder as the audit evidence."""
+    import json as _json
+    import urllib.request as _rq
+
+    from butterfly_tpu.fleet.autoscale import Autoscaler, TierPolicy
+    from butterfly_tpu.fleet.harness import start_fleet
+
+    lg = _loadgen()
+    shared_len = page_size * 4
+    tail = page_size // 2
+    fleet = start_fleet(topology, page_size=page_size,
+                        max_batch=max_batch,
+                        max_seq=shared_len + tail + max_tokens + 16,
+                        probe_interval=0.1,
+                        slo_ttft_s=slo_ttft_ms / 1e3,
+                        slo_itl_s=slo_itl_ms / 1e3,
+                        warm_len=shared_len + tail)
+    try:
+        n0 = len(fleet.replicas)
+        pol = TierPolicy("decode", min_replicas=1,
+                         max_replicas=max_decode, signal="queue_depth",
+                         high=signal_high, low=signal_low, window=2,
+                         cooldown_up_s=0.5,
+                         cooldown_down_s=cooldown_down_s)
+        scaler = Autoscaler(fleet.state, fleet.spawn, fleet.retire,
+                            [pol], interval_s=0.2)
+        scaler.start()
+        t0 = time.monotonic()
+        load = lg.run_load(fleet.url, clients=clients,
+                           requests_per_client=requests_per_client,
+                           prefix_share=0.5, shared_len=shared_len,
+                           tail_len=tail, max_tokens=max_tokens,
+                           seed=seed, slo_ttft_ms=slo_ttft_ms,
+                           slo_itl_ms=slo_itl_ms, arrival=arrival)
+        # settle: idle rings drain below the low band and the
+        # hysteresis window elapses — the scale-down half of the claim
+        deadline = time.monotonic() + settle_s
+        while time.monotonic() < deadline:
+            if scaler.stats()["scale_downs"] > 0:
+                break
+            time.sleep(0.2)
+        wall = time.monotonic() - t0
+        scaler.stop()
+        st = scaler.stats()
+        # replay the event log to find the peak shape the fleet reached
+        ns, n = [n0], n0
+        for e in st["events"]:
+            n += 1 if e["direction"] == "up" else -1
+            ns.append(n)
+        peak = max(ns)
+        with _rq.urlopen(fleet.url + "/debug/flightrecorder",
+                         timeout=10.0) as resp:
+            rec = _json.loads(resp.read())
+        scale_events = [e for e in rec.get("events", ())
+                        if e.get("kind") == "scale"]
+    finally:
+        fleet.stop()
+    static_peak = peak * wall
+    return {
+        "autoscale_topology": topology,
+        "autoscale_arrival": arrival,
+        "autoscale_requests": load["sent"],
+        "autoscale_dropped": load["failed"],
+        "autoscale_slo_ttft_ms": slo_ttft_ms,
+        "autoscale_slo_itl_ms": slo_itl_ms,
+        "autoscale_slo_attainment": load.get("slo_attainment"),
+        "autoscale_ttft_p95_s": load.get("ttft_p95_s"),
+        # the cost side: integral of live replicas over the soak vs a
+        # static fleet provisioned at the peak shape the whole time
+        "autoscale_replica_seconds": round(st["replica_seconds"], 3),
+        "autoscale_static_peak_replica_seconds": round(static_peak, 3),
+        "autoscale_replica_seconds_saved_frac": round(
+            1.0 - st["replica_seconds"] / static_peak, 4)
+        if static_peak > 0 else 0.0,
+        "autoscale_peak_replicas": peak,
+        "autoscale_scale_ups": st["scale_ups"],
+        "autoscale_scale_downs": st["scale_downs"],
+        # audit evidence: the decisions as served by the control
+        # plane's /debug/flightrecorder
+        "autoscale_flightrec_scale_events": len(scale_events),
     }
 
 

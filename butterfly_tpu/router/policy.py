@@ -107,7 +107,17 @@ class PrefixAffinityPolicy:
         self.page_size = page_size
         self.affinity_blocks = affinity_blocks
         self.saturate_after = saturate_after
+        self._vnodes = vnodes
         self.ring = HashRing(list(pool.replicas), vnodes=vnodes)
+
+    def rebuild_ring(self) -> None:
+        """Re-derive the ring from current pool membership — the fleet
+        elasticity hook (autoscaler spawn/retire). Vnode placement is
+        deterministic per rid, so surviving replicas keep their arcs
+        (consistent hashing's point): only the joined/removed member's
+        arcs remap. Atomic swap: plan() readers see old or new ring,
+        never a half-built one."""
+        self.ring = HashRing(list(self.pool.replicas), vnodes=self._vnodes)
 
     def plan(self, tokens: Optional[List[int]], role: Optional[str] = None
              ) -> Tuple[List[Replica], Optional[str]]:

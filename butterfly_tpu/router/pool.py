@@ -372,6 +372,38 @@ class ReplicaPool:
         with self._lock:
             return sum(r.breaker_opens for r in self.replicas.values())
 
+    # -- membership changes (fleet elasticity) -------------------------------
+
+    def add(self, spec: str) -> str:
+        """Register a new backend at runtime (the autoscaler's
+        spawn-attach). The member starts optimistically LIVE with
+        next_probe_t due immediately — the very next probe cycle (or an
+        explicit probe_one) learns its role and load signals. Returns
+        the canonical rid; raises on a duplicate."""
+        host, port = parse_backend(spec)
+        rid = f"{host}:{port}"
+        with self._lock:
+            if rid in self.replicas:
+                raise ValueError(f"duplicate backend {rid}")
+            self.replicas[rid] = Replica(rid, host, port)
+            if self._g_out is not None:
+                self._g_out.labels(rid).set(0)
+        return rid
+
+    def remove(self, rid: str) -> bool:
+        """Forget a backend at runtime (the autoscaler's retire, called
+        AFTER drain + stop — the pool does no draining itself). False
+        if the rid is unknown. The last member cannot be removed: an
+        empty pool can route nothing and __init__ forbids starting
+        that way."""
+        with self._lock:
+            if rid not in self.replicas:
+                return False
+            if len(self.replicas) == 1:
+                raise ValueError("cannot remove the last replica")
+            del self.replicas[rid]
+            return True
+
     # -- admin ---------------------------------------------------------------
 
     def set_drain(self, rid: str, draining: bool) -> Optional[dict]:

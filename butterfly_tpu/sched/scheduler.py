@@ -379,6 +379,48 @@ class Scheduler:
             "whose requests died before a flush are dropped, not "
             "counted")
         self._kv_flushes: Deque[float] = deque(maxlen=4096)
+        # Host-RAM KV tier (ISSUE 17, cache/hosttier.py): prefix-cache
+        # eviction demotes page bytes to host DRAM (optionally spilling
+        # to disk) instead of dropping them, and admission's prefix
+        # walk revives them on a hit — the evict/revive hooks installed
+        # on the allocator here are the only device-touching halves
+        # (read_pages on evict, write_pages on revive); the tier itself
+        # is pure host state. Off (None) unless prefix caching is on
+        # AND a tier budget is declared.
+        self.host_tier = None
+        self._g_tier_hit = None
+        self._tier_restores: Deque[float] = deque(maxlen=4096)
+        if rt.prefix_caching and (rt.host_kv_tier_mb or 0) > 0:
+            from butterfly_tpu.cache.hosttier import HostKVTier
+            self.host_tier = HostKVTier(
+                int(rt.host_kv_tier_mb * 1024 * 1024),
+                spill_dir=rt.host_kv_tier_dir)
+            self.alloc.on_evict = self._tier_save
+            self.alloc.reviver = self._tier_revive
+            self._c_tier_saved = reg.counter(
+                "kv_tier_pages_saved_total",
+                "KV pages demoted to the host tier at prefix-cache "
+                "eviction (read_pages -> host DRAM) instead of dropped")
+            self._c_tier_restored = reg.counter(
+                "kv_tier_pages_restored_total",
+                "KV pages revived from the host tier on a prefix hit "
+                "(import_page + write_pages) — prefill work the tier "
+                "saved")
+            self._c_tier_miss = reg.counter(
+                "kv_tier_misses_total",
+                "Prefix-walk registry misses the host tier could not "
+                "serve either (the chain was never demoted, or aged "
+                "out of the tier's budget)")
+            self._h_tier_restore = reg.histogram(
+                "kv_tier_restore_seconds",
+                "Host wall time to revive one page from the host tier "
+                "(tier lookup + import_page + the device scatter)",
+                LATENCY_BUCKETS)
+            self._g_tier_hit = reg.gauge(
+                "kv_tier_hit_rate",
+                "Fraction of host-tier lookups served (restores / "
+                "(restores + misses), all paths including export) — "
+                "the tier-effectiveness signal dashboards sparkline")
         # SLO attainment (ISSUE 7): declared objectives make latency a
         # pass/fail measurement per request instead of a percentile to
         # eyeball. None = no objective declared: zero accounting runs
@@ -836,6 +878,8 @@ class Scheduler:
             "kv_pages_free": float(self.alloc.free_pages),
             "slo_burn_rate": self._g_slo_burn.value,
         }
+        if self.host_tier is not None:
+            gauges["kv_tier_hit_rate"] = self._tier_hit_rate()
         total = self._t_host_total + self._t_device_total
         if total > 0.0:
             gauges["tick_host_frac"] = self._t_host_total / total
@@ -895,6 +939,21 @@ class Scheduler:
         if hasattr(self.alloc, "hit_tokens"):
             m["prefix_cache_hit_tokens"] = self.alloc.hit_tokens
             m["prefix_cache_lookup_tokens"] = self.alloc.lookup_tokens
+        if self.host_tier is not None:
+            st = self.host_tier.stats()
+            m["kv_tier_pages"] = st["entries"] + st["spilled_entries"]
+            m["kv_tier_bytes"] = st["bytes"]
+            m["kv_tier_pages_saved_total"] = st["saves"]
+            m["kv_tier_pages_restored_total"] = st["restores"]
+            m["kv_tier_misses_total"] = st["misses"]
+            m["kv_tier_spills_total"] = st["spills"]
+            m["kv_tier_hit_rate"] = self._tier_hit_rate()
+            if self._tier_restores:
+                a = np.asarray(self._tier_restores)
+                m["kv_tier_restore_seconds_p50"] = \
+                    float(np.percentile(a, 50))
+                m["kv_tier_restore_seconds_p95"] = \
+                    float(np.percentile(a, 95))
         if self._ttfts:
             a = np.asarray(self._ttfts)
             m["ttft_p50"] = float(np.percentile(a, 50))
@@ -974,6 +1033,58 @@ class Scheduler:
         with fam._lock:
             items = list(fam._children.items())
         return {vals[0]: child.value for vals, child in items}
+
+    # -- host KV tier hooks (cache/hosttier.py) ------------------------------
+
+    def _tier_hit_rate(self) -> float:
+        st = self.host_tier
+        lookups = st.restores + st.misses
+        return st.restores / lookups if lookups else 0.0
+
+    def _tier_save(self, h: bytes, pid: int) -> None:
+        """Allocator on_evict hook: demote the recycled page's bytes to
+        the host tier. The page is registered (content-immutable) until
+        this very moment, so the gather reads stable bytes; read_pages
+        flushes the write-combined window itself if it is dirty. The
+        allocator swallows exceptions — a failed demotion costs a
+        future prefill, never correctness."""
+        k, v, ks, vs = self.engine.read_pages([pid])
+        self.host_tier.save(h, k[:, 0], v[:, 0],
+                            None if ks is None else ks[:, 0],
+                            None if vs is None else vs[:, 0])
+        self._c_tier_saved.inc()
+
+    def _tier_revive(self, h: bytes) -> Optional[int]:
+        """Allocator reviver hook: on a registry miss during admission's
+        prefix walk, pull the chain's next page back from the host tier
+        into a freshly claimed page. Returns the page id (the walk
+        continues as a normal prefix hit) or None on a tier miss /
+        page exhaustion (the admission prefills the tail itself)."""
+        t0 = time.monotonic()
+        data = self.host_tier.load(h)
+        if data is None:
+            self._c_tier_miss.inc()
+            self._g_tier_hit.set(self._tier_hit_rate())
+            return None
+        try:
+            pid = self.alloc.import_page(h)
+        except MemoryError:
+            return None  # every page held by a live slot: no revive
+        if pid is None:
+            # digest already registered (idempotent re-import shape):
+            # serve the walk from the live entry
+            return self.alloc.lookup(h)
+        k, v, ks, vs = data
+        self.engine.write_pages(
+            [pid], k[:, None], v[:, None],
+            None if ks is None else ks[:, None],
+            None if vs is None else vs[:, None])
+        dt = time.monotonic() - t0
+        self._h_tier_restore.observe(dt)
+        self._tier_restores.append(dt)
+        self._c_tier_restored.inc()
+        self._g_tier_hit.set(self._tier_hit_rate())
+        return pid
 
     # -- internals ----------------------------------------------------------
 

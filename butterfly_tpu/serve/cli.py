@@ -110,6 +110,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reuse KV pages across requests sharing a prompt "
                         "prefix (content-hashed, refcounted; cuts TTFT for "
                         "shared system prompts)")
+    s.add_argument("--host-tier-mb", type=float, default=0.0,
+                   help="host-RAM KV tier budget in MiB (requires "
+                        "--prefix-caching): device-pool evictions demote "
+                        "pages to host memory instead of dropping them, "
+                        "and a later prefix hit on an evicted chain "
+                        "revives the pages back to device — TTFT of a "
+                        "warm hit at host-RAM prices. 0 (default) = off")
+    s.add_argument("--host-tier-dir", default=None, metavar="DIR",
+                   help="optional disk-spill directory for the host KV "
+                        "tier: pages LRU-demoted past --host-tier-mb "
+                        "spill to .npz files here instead of being "
+                        "dropped (a third tier below host RAM)")
     s.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
                    help="serving-path speculative decoding on the block "
                         "pipeline: draft GAMMA tokens per slot from the "
@@ -244,6 +256,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "idle — plus the decode_steps_per_tick x "
                         "inflight_blocks operating-point table + knee; "
                         "merges mixed_* keys into the JSON line")
+    b.add_argument("--host-tier-mb", type=float, default=0.0,
+                   help="with --mixed: give the engine a host-RAM KV "
+                        "tier of this many MiB so the contested pool "
+                        "demotes/revives instead of dropping — merges "
+                        "kv_tier_hit_rate and kv_tier_restore_seconds_"
+                        "p50/p95 into the JSON line")
 
     # multi-replica router: fronts N `butterfly serve` replicas with
     # prefix-affinity routing + health-aware failover (router/). Loads no
@@ -309,6 +327,31 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--max-batch", type=int, default=2)
     f.add_argument("--max-seq", type=int, default=128)
     f.add_argument("--disagg-threshold", type=int, default=16)
+    f.add_argument("--autoscale", action="store_true",
+                   help="run the closed-loop autoscaler (fleet/"
+                        "autoscale.py) on every tier in the topology: "
+                        "scraped queue-depth ring history grows a "
+                        "saturated tier (warm-before-join) and shrinks "
+                        "an idle one (drain-before-retire), "
+                        "independently per tier; decisions land in "
+                        "GET /debug/flightrecorder")
+    f.add_argument("--scale-min", type=int, default=1,
+                   help="autoscaler floor per tier (default 1)")
+    f.add_argument("--scale-max", type=int, default=4,
+                   help="autoscaler ceiling per tier (default 4)")
+    f.add_argument("--scale-high", type=float, default=4.0,
+                   help="tier-mean queue_depth above which a tier "
+                        "grows (default 4.0)")
+    f.add_argument("--scale-low", type=float, default=0.5,
+                   help="tier-mean queue_depth below which a tier "
+                        "shrinks, after the hysteresis cooldown "
+                        "(default 0.5)")
+    f.add_argument("--host-tier-mb", type=float, default=0.0,
+                   help="per-replica host-RAM KV tier budget in MiB "
+                        "(see `serve --host-tier-mb`); 0 = off")
+    f.add_argument("--host-tier-dir", default=None, metavar="DIR",
+                   help="disk-spill directory for the replicas' host "
+                        "KV tiers (see `serve --host-tier-dir`)")
     f.add_argument("--chaos", default=None, metavar="PLAN",
                    help="seeded fault-injection plan: a JSON file "
                         '({"seed": N, "faults": [{"kind": "delay|error|'
@@ -641,6 +684,7 @@ def cmd_bench(args) -> int:
             max_new_lo=max(4, args.max_new // 4),
             max_new_hi=args.max_new,
             inflight_blocks=args.inflight_blocks,
+            host_kv_tier_mb=getattr(args, "host_tier_mb", 0.0),
             kv_quant=args.kv_quant))
     print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
                       "value": stats["decode_tokens_per_sec_per_chip"],
@@ -699,8 +743,27 @@ def cmd_fleet(args) -> int:
                         max_batch=args.max_batch, max_seq=args.max_seq,
                         disagg_threshold=args.disagg_threshold,
                         chaos=chaos,
+                        host_kv_tier_mb=getattr(args, "host_tier_mb", 0.0),
+                        host_kv_tier_dir=getattr(args, "host_tier_dir",
+                                                 None),
                         slo_ttft_s=slo_ttft / 1e3 if slo_ttft else None,
                         slo_itl_s=slo_itl / 1e3 if slo_itl else None)
+    scaler = None
+    if getattr(args, "autoscale", False):
+        from butterfly_tpu.fleet.autoscale import Autoscaler, TierPolicy
+        from butterfly_tpu.fleet.harness import parse_topology
+        policies = [TierPolicy(role, min_replicas=args.scale_min,
+                               max_replicas=args.scale_max,
+                               high=args.scale_high, low=args.scale_low)
+                    for role in dict.fromkeys(parse_topology(args.topology))]
+        scaler = Autoscaler(fleet.state, fleet.spawn, fleet.retire,
+                            policies, interval_s=1.0)
+        scaler.start()
+        print(f"[butterfly] autoscaler live on "
+              f"{[p.role for p in policies]} "
+              f"(bounds {args.scale_min}..{args.scale_max}, band "
+              f"{args.scale_low}..{args.scale_high}; decisions at "
+              f"GET /debug/flightrecorder)", flush=True)
     print(f"[butterfly] control plane: {fleet.url}  "
           f"(GET /fleet/state, POST /generate)", flush=True)
     for r in fleet.replicas:
@@ -712,6 +775,8 @@ def cmd_fleet(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if scaler is not None:
+            scaler.stop()
         fleet.stop()
     return 0
 

@@ -1231,6 +1231,8 @@ def run_server(args) -> int:
                        top_k=args.top_k, top_p=args.top_p,
                        max_queue=args.max_queue,
                        prefix_caching=getattr(args, "prefix_caching", False),
+                       host_kv_tier_mb=getattr(args, "host_tier_mb", 0.0),
+                       host_kv_tier_dir=getattr(args, "host_tier_dir", None),
                        kv_quant=getattr(args, "kv_quant", "none"),
                        speculative_gamma=getattr(args, "speculate", 0),
                        draft_model=getattr(args, "draft_source", "ngram"),

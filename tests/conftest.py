@@ -170,6 +170,14 @@ SLOW_TESTS = {
     "test_fleet_deadline_spent_at_arrival_is_504",
     "test_chaos_soak_terminal_outcomes",
     "test_preempt_prefers_batch_victim",
+    # elastic fleet (ISSUE 17): live spawn/retire topologies (the fast
+    # tier keeps the whole control-loop unit grid on the fake pool —
+    # including the hysteresis tests mutcheck leans on — plus topology
+    # parsing)
+    "test_spawned_replica_joins_and_serves",
+    "test_retire_drains_without_dropping_requests",
+    "test_autoscaler_closes_the_loop_on_a_live_fleet",
+    "test_autoscale_benchmark_beats_static_peak",
 }
 
 

@@ -14,4 +14,6 @@ def probe(url, host, port, kw):
     with urlopen(url,
                  timeout=30) as resp:                  # multi-line kw
         resp.read()
-    return a, b, c, d, e
+    f = urlopen(url + "/debug/flightrecorder",
+                timeout=10.0)                          # control-loop pull
+    return a, b, c, d, e, f

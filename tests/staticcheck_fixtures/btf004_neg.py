@@ -41,3 +41,27 @@ def make_handler(state):
                 state._g_depth.set(1)
 
     return Handler
+
+
+class HostTier:
+    """The host-KV-tier idiom: the tier lock guards pure in-memory
+    dict/array bookkeeping only; any peer fetch happens BEFORE taking
+    it, so allocator threads never wait on a remote."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chains = {}
+
+    def pull_from_peer(self, url, chain):
+        body = urllib.request.urlopen(url, timeout=5.0).read()
+        with self._lock:                              # memory-only span
+            self._chains[chain] = body
+
+
+def make_kv_handler(state):
+    class KvHandler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            with state._mlock:
+                state._h_restore.observe(0.01)        # locked observe
+
+    return KvHandler

@@ -1,8 +1,11 @@
 """BTF004 positive fixture: lock-discipline violations.
 
-Expected findings: 5 — an unbounded .acquire(), network I/O under a
-lock, a raw `with state.lock:` in a handler class, and two unlocked
-instrument writes in a handler class.
+Expected findings: 7 — an unbounded .acquire(), network I/O under a
+lock, a raw `with state.lock:` in a handler class, two unlocked
+instrument writes in a handler class, a host-tier pull that fetches
+pages from a peer while holding the tier lock (every allocator waiting
+on that lock inherits the peer's latency), and an unlocked histogram
+observe in a handler class.
 """
 import threading
 import urllib.request
@@ -30,3 +33,22 @@ def make_handler(state):
             state._g_depth.set(n)                            # 5
 
     return Handler
+
+
+class HostTier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chains = {}
+
+    def pull_from_peer(self, url, chain):
+        with self._lock:
+            body = urllib.request.urlopen(url, timeout=5.0)  # 6
+            self._chains[chain] = body.read()
+
+
+def make_kv_handler(state):
+    class KvHandler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            state._h_restore.observe(0.01)                   # 7
+
+    return KvHandler

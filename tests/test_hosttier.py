@@ -1,0 +1,342 @@
+"""Host-RAM KV tier (cache/hosttier.py, ISSUE 17): evict-to-host
+instead of drop, revive on prefix hit, export continuation.
+
+Three layers:
+
+* pure tier unit tests — byte-exact save/load, LRU byte budget,
+  disk spill + promote;
+* allocator hook tests — `on_evict` fires at the deregistration
+  moment, `reviver` turns a registry miss into a continued prefix
+  walk, and every path holds the full-accounting invariant;
+* scheduler integration (CPU) — the full demote/revive round trip is
+  byte-exact on the device for BOTH float32 and int8 pools, the
+  kv_tier_* metrics move, and export_payload continues a chain from
+  the tier after the device registry evicted it.
+"""
+import numpy as np
+import pytest
+
+from butterfly_tpu.cache.hosttier import HostKVTier
+from butterfly_tpu.cache.prefix import (
+    PrefixCachingAllocator, chain_block_hashes)
+from butterfly_tpu.core.config import RuntimeConfig, tiny
+from butterfly_tpu.engine.serving import ServingEngine
+from butterfly_tpu.fleet.kvtransfer import export_payload, import_payload
+from butterfly_tpu.models.common import Model
+from butterfly_tpu.sched.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# tier unit tests (pure host)
+# ---------------------------------------------------------------------------
+
+def page(seed, shape=(2, 1, 4, 3), dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    if np.dtype(dtype) == np.int8:
+        return rng.randint(-128, 128, size=shape).astype(np.int8)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+def test_tier_round_trip_byte_exact():
+    tier = HostKVTier(1 << 20)
+    k, v = page(1), page(2)
+    tier.save(b"h1", k, v)
+    got = tier.load(b"h1")
+    assert got is not None
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    assert got[2] is None and got[3] is None
+    # int8 codes + scales survive exactly too
+    k8, v8 = page(3, dtype=np.int8), page(4, dtype=np.int8)
+    ks, vs = page(5, shape=(2, 4)), page(6, shape=(2, 4))
+    tier.save(b"h2", k8, v8, ks, vs)
+    g = tier.load(b"h2")
+    for a, b in zip(g, (k8, v8, ks, vs)):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+    assert tier.misses == 0 and tier.restores == 2
+    assert tier.load(b"nope") is None
+    assert tier.misses == 1
+
+
+def test_tier_save_copies_and_is_idempotent():
+    tier = HostKVTier(1 << 20)
+    k, v = page(1), page(2)
+    tier.save(b"h", k, v)
+    k[:] = 0  # caller hands a view; the tier must have copied
+    got = tier.load(b"h")
+    assert float(np.abs(got[0]).sum()) > 0
+    before = tier.bytes_used
+    tier.save(b"h", got[0], got[1])  # re-save: refresh, not leak
+    assert tier.bytes_used == before
+    assert tier.stats()["entries"] == 1
+
+
+def test_tier_lru_byte_budget_drops_oldest():
+    one = _nbytes = page(0).nbytes * 2
+    tier = HostKVTier(one * 2 + 1)  # room for two entries
+    for i, h in enumerate((b"a", b"b", b"c")):
+        tier.save(h, page(i), page(i + 10))
+    assert tier.drops == 1 and not tier.contains(b"a")
+    assert tier.contains(b"b") and tier.contains(b"c")
+    assert tier.bytes_used <= tier.capacity_bytes
+    # a load refreshes LRU order: b becomes newest, so d drops c
+    assert tier.load(b"b") is not None
+    tier.save(b"d", page(7), page(8))
+    assert tier.contains(b"b") and not tier.contains(b"c")
+
+
+def test_tier_disk_spill_and_promote(tmp_path):
+    one = page(0).nbytes * 2
+    tier = HostKVTier(one * 2 + 1, spill_dir=str(tmp_path))
+    pages = {h: (page(i), page(i + 10))
+             for i, h in enumerate((b"a", b"b", b"c"))}
+    for h, (k, v) in pages.items():
+        tier.save(h, k, v)
+    # oldest spilled to disk, nothing lost
+    assert tier.spills == 1 and tier.drops == 0
+    assert tier.stats()["spilled_entries"] == 1
+    assert tier.contains(b"a")
+    got = tier.load(b"a")  # promote back: byte-exact through the .npz
+    np.testing.assert_array_equal(got[0], pages[b"a"][0])
+    np.testing.assert_array_equal(got[1], pages[b"a"][1])
+    assert tier.stats()["spilled_entries"] == 1  # promotion respilled b
+    assert tier.bytes_used <= tier.capacity_bytes
+
+
+# ---------------------------------------------------------------------------
+# allocator hooks (pure host)
+# ---------------------------------------------------------------------------
+
+PS = 4
+
+
+def chain(tokens):
+    return chain_block_hashes(tokens, PS)
+
+
+def register_chain(a, slot, tokens):
+    """Admit + register a token chain, then release it so its pages sit
+    warm in the evictable list (the demotion candidates)."""
+    got = a.admit(slot, tokens, len(tokens))
+    assert got is not None
+    a.register(slot, tokens)
+    a.release(slot)
+    a.check_invariants()
+
+
+def test_on_evict_fires_with_digest_and_page():
+    a = PrefixCachingAllocator(4, PS, 8)
+    demoted = []
+    a.on_evict = lambda h, pid: demoted.append((h, pid))
+    toks = list(range(4 * PS))
+    register_chain(a, 0, toks)  # 4 pages registered, all evictable
+    # a fresh 3-page admission with an empty free list recycles 3
+    # registered pages through _take_free -> _evict_one. release()
+    # decrefs deepest-first, so the LRU demotes the chain TAIL first —
+    # exactly right for prefix reuse (shallow prefixes stay warm
+    # longest).
+    assert a.admit(1, [99] * (3 * PS), 3 * PS) == 0
+    a.check_invariants()
+    hashes = chain(toks)
+    assert [h for h, _ in demoted] == [hashes[3], hashes[2], hashes[1]]
+    # the digests seen by the hook are no longer in the registry
+    assert all(a.lookup(h) is None for h, _ in demoted)
+
+
+def test_on_evict_failure_never_breaks_eviction():
+    a = PrefixCachingAllocator(2, PS, 8)
+
+    def boom(h, pid):
+        raise RuntimeError("tier unavailable")
+
+    a.on_evict = boom
+    register_chain(a, 0, list(range(2 * PS)))
+    assert a.admit(1, [5] * (2 * PS), 2 * PS) == 0  # evicts through boom
+    a.check_invariants()
+
+
+def test_reviver_continues_the_prefix_walk():
+    a = PrefixCachingAllocator(6, PS, 8)
+    toks = list(range(3 * PS + 1))  # 3 matchable pages + 1 spare token
+    register_chain(a, 0, toks)
+    # evict everything into a fake tier keyed by digest
+    tier = {}
+    a.on_evict = lambda h, pid: tier.setdefault(h, pid)
+    assert a.admit(1, [7] * (6 * PS), 6 * PS) == 0  # recycles all 3
+    a.release(1)
+    assert all(a.lookup(h) is None for h in chain(toks))
+
+    revived = []
+
+    def reviver(h):
+        if h not in tier:
+            return None
+        pid = a.import_page(h)
+        if pid is None:
+            return a.lookup(h)
+        revived.append(h)
+        return pid
+
+    a.reviver = reviver
+    got = a.admit(2, toks, len(toks))
+    assert got == 3 * PS  # the whole chain came back as a prefix hit
+    assert revived == chain(toks)
+    a.check_invariants()
+    a.release(2)
+    a.check_invariants()
+
+
+def test_reviver_rollback_leaves_revived_pages_warm():
+    """A revive followed by a does-not-fit rollback must leave the
+    revived pages registered + evictable (warm), with invariants
+    intact — the next admission of the chain hits them for free."""
+    a = PrefixCachingAllocator(4, PS, 5)
+    toks = list(range(2 * PS + 1))  # 2 matchable pages
+    register_chain(a, 0, toks)
+    tier = {}
+    a.on_evict = lambda h, pid: tier.setdefault(h, pid)
+    # recycle every page: the registered pair lands in the tier
+    assert a.admit(1, [7] * (4 * PS), 4 * PS) == 0
+    a.check_invariants()
+    a.release(1)
+
+    def reviver(h):
+        if h not in tier:
+            return None
+        try:
+            pid = a.import_page(h)
+        except MemoryError:
+            return None
+        return a.lookup(h) if pid is None else pid
+
+    a.reviver = reviver
+    # 17 tokens need 5 pages: both tier pages revive (2 imports leave 2
+    # free), then want=3 > 2 available -> admit refuses AFTER reviving,
+    # exercising the rollback leg over revived pages
+    assert a.admit(2, toks, 4 * PS + 1) is None
+    a.check_invariants()
+    assert all(a.lookup(h) is not None for h in chain(toks))
+    # the warm revived pages now serve a fitting admission as plain
+    # hits — the reviver is not consulted again
+    a.reviver = None
+    got = a.admit(3, toks, len(toks))
+    assert got == 2 * PS
+    a.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration (CPU)
+# ---------------------------------------------------------------------------
+
+def make_sched(**rt_kw):
+    cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    model = Model(cfg)
+    import jax
+    params = model.init(jax.random.PRNGKey(0))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                       prefix_caching=True, host_kv_tier_mb=8.0,
+                       **rt_kw)
+    return Scheduler(ServingEngine(model, params, rt, use_kernels=False))
+
+
+PROMPT_A = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3, 8, 4]
+PROMPT_B = [11, 13, 17, 19, 23] * 4
+
+
+def run_one(sched, prompt, max_new=6):
+    req = sched.submit(prompt, max_new_tokens=max_new)
+    sched.run_until_done()
+    assert req.state == "finished"
+    return req.output
+
+
+def snapshot_chain(sched, tokens):
+    """(hashes, per-page host bytes) for the registered leading run of
+    `tokens` — the byte-exactness reference."""
+    hashes, pids = [], []
+    for h in chain_block_hashes(tokens, sched.alloc.page_size):
+        pid = sched.alloc.lookup(h)
+        if pid is None:
+            break
+        hashes.append(h)
+        pids.append(pid)
+    assert pids, "expected a registered chain to snapshot"
+    return hashes, sched.engine.read_pages(pids)
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_evict_to_host_round_trip_byte_exact(kv_quant):
+    # num_pages=5 -> 4 allocator pages: PROMPT_A (20 tok + 6 new) holds
+    # all 4, so PROMPT_B's admission must recycle A's registered pages
+    # through the tier
+    s = make_sched(num_pages=5, kv_quant=kv_quant)
+    out_a = run_one(s, PROMPT_A)
+    written = (PROMPT_A + out_a)[:-1]
+    hashes, (k0, v0, ks0, vs0) = snapshot_chain(s, written)
+    run_one(s, PROMPT_B)  # forces eviction of A's chain tail
+    # the LRU demotes deepest-first: the tail pages now live ONLY in
+    # the host tier, the chain head may stay registered
+    evicted = [h for h in hashes if s.alloc.lookup(h) is None]
+    assert len(evicted) >= 2
+    assert s.host_tier.saves >= len(evicted)
+    # resubmit A: the reviver pulls the chain back from the tier and
+    # the request decodes the same tokens it did the first time
+    # resubmitting A revives the evicted matchable page(s); the page
+    # covering generated tokens is simply recomputed (matchable caps
+    # at the prompt, so it can never be asked for at admission)
+    assert run_one(s, PROMPT_A) == out_a
+    m = s.metrics()
+    assert m["kv_tier_pages_restored_total"] >= 1
+    assert m["kv_tier_hit_rate"] > 0
+    assert "kv_tier_restore_seconds_p50" in m
+    assert "kv_tier_restore_seconds_p95" in m
+    # byte-exactness on the DEVICE: the revived pages hold exactly the
+    # bytes the evicted pages held (codes AND scales for int8)
+    pids = [s.alloc.lookup(h) for h in hashes]
+    assert all(p is not None for p in pids)
+    k1, v1, ks1, vs1 = s.engine.read_pages(pids)
+    np.testing.assert_array_equal(k1, k0)
+    np.testing.assert_array_equal(v1, v0)
+    if kv_quant == "int8":
+        np.testing.assert_array_equal(ks1, ks0)
+        np.testing.assert_array_equal(vs1, vs0)
+    else:
+        assert ks0 is None and ks1 is None
+
+
+def test_export_payload_continues_from_tier():
+    """A chain this replica evicted to host stays exportable: the
+    /kv/pages surface serves the still-registered head from the device
+    pool and CONTINUES the run from the tier where the registry
+    misses, and a peer replica imports the whole chain byte-exactly."""
+    src = make_sched(num_pages=5)
+    out_a = run_one(src, PROMPT_A)
+    written = (PROMPT_A + out_a)[:-1]
+    hashes, (k0, v0, _, _) = snapshot_chain(src, written)
+    run_one(src, PROMPT_B)  # A's chain tail now lives only in the tier
+    assert any(src.alloc.lookup(h) is None for h in hashes)
+    hexes = [h.hex() for h in hashes]
+    payload = export_payload(src, hexes)
+    assert [p["hash"] for p in payload["pages"]] == hexes
+    assert payload["missing"] == []
+    dst = make_sched(num_pages=16)
+    res = import_payload(dst, payload)
+    assert res["imported"] == len(hashes) and not res["no_space"]
+    pids = [dst.alloc.lookup(h) for h in hashes]
+    k1, v1, _, _ = dst.engine.read_pages(pids)
+    np.testing.assert_array_equal(k1, k0)
+    np.testing.assert_array_equal(v1, v0)
+
+
+def test_tier_off_by_default():
+    cfg = tiny("llama", dtype="float32", param_dtype="float32")
+    model = Model(cfg)
+    import jax
+    params = model.init(jax.random.PRNGKey(0))
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                       prefix_caching=True)
+    s = Scheduler(ServingEngine(model, params, rt, use_kernels=False))
+    assert s.host_tier is None
+    assert s.alloc.on_evict is None and s.alloc.reviver is None
+    assert "kv_tier_hit_rate" not in s.metrics()

@@ -200,6 +200,103 @@ def test_grow_evicts_warm_pages():
     a.check_invariants()
 
 
+def test_import_page_mid_chain_memory_error_recovery():
+    """A KV import that exhausts pages mid-chain (import_page raises
+    MemoryError) must leave a usable LEADING run: the landed pages stay
+    registered + evictable, invariants hold, and the next admission of
+    the chain attaches exactly the landed prefix. This is the no_space
+    leg of fleet/kvtransfer.import_payload, driven at allocator level."""
+    a = PrefixCachingAllocator(num_pages=3, page_size=PS,
+                               max_pages_per_seq=8)
+    # a LIVE slot owns the chain's leading 2 pages (registered, ref>0)
+    # plus one private page: the whole pool is held, nothing evictable
+    seq = list(range(2 * PS + 1))
+    a.admit(0, seq, len(seq))
+    a.register(0, seq)
+    a.check_invariants()
+    chain = chain_block_hashes(list(range(3 * PS)), PS)
+    # the peer's import walks the chain: the live-shared head skips
+    # idempotently (None), then the tail exhausts mid-chain
+    assert a.import_page(chain[0]) is None
+    assert a.import_page(chain[1]) is None
+    with pytest.raises(MemoryError):
+        a.import_page(chain[2])
+    a.check_invariants()
+    # what landed (the live head) is still a usable leading run
+    assert a.lookup(chain[0]) is not None
+    assert a.lookup(chain[1]) is not None
+    assert a.lookup(chain[2]) is None
+    # the holder releasing unblocks the tail; the re-import completes
+    a.release(0)
+    a.check_invariants()
+    assert a.import_page(chain[2]) is not None
+    a.check_invariants()
+    # admission attaches the whole chain as a prefix hit (need_len
+    # capped at the pool: 3 matched pages, zero fresh)
+    got = a.admit(1, list(range(3 * PS)) + [99], 3 * PS)
+    assert got == 3 * PS
+    a.check_invariants()
+
+
+def test_import_into_tight_pool_recycles_earlier_imports():
+    """An import chain longer than the free headroom never raises while
+    its OWN earlier pages are the only evictable ones — it recycles
+    them (newest import wins, the leading run is sacrificed). Documents
+    the churn shape import_payload tolerates: correctness never depends
+    on a transfer landing, and invariants hold throughout."""
+    a = PrefixCachingAllocator(num_pages=3, page_size=PS,
+                               max_pages_per_seq=8)
+    a.admit(0, [9] * (2 * PS), 2 * PS)  # 2 of 3 pages live
+    chain = chain_block_hashes(list(range(3 * PS)), PS)
+    first = a.import_page(chain[0])
+    assert first is not None
+    # second import: the only evictable page is chain[0]'s — recycled
+    assert a.import_page(chain[1]) == first
+    a.check_invariants()
+    assert a.lookup(chain[0]) is None
+    assert a.lookup(chain[1]) == first
+    # the surviving non-leading page is unusable by admit (walks from
+    # block 0) but harmless; it recycles like any warm page
+    assert a.admit(1, list(range(PS + 1)), PS) == 0
+    a.check_invariants()
+
+
+def test_pin_unpin_refcount_vs_evictable_invariant():
+    """The cache/prefix.py audit contract: (refcount == 0) iff the page
+    sits in the evictable list. Transfer pins are transient holders —
+    a pinned warm page must leave the evictable list (and stop being
+    eviction fodder), and an unpin must return it warm. The
+    check_invariants audit only balances once pins are released, which
+    is exactly the export path's pin/read/unpin-in-finally shape."""
+    a = PrefixCachingAllocator(num_pages=3, page_size=PS,
+                               max_pages_per_seq=8)
+    seq = list(range(2 * PS + 1))
+    a.admit(0, seq, len(seq))
+    a.register(0, seq)
+    a.release(0)
+    a.check_invariants()
+    pids = [a.lookup(h) for h in chain_block_hashes(seq, PS)]
+    assert all(p is not None for p in pids)
+    assert all(a._ref[p] == 0 and p in a._evictable for p in pids)
+    a.pin(pids)
+    # pinned: held, not evictable — and not free headroom either
+    assert all(a._ref[p] == 1 and p not in a._evictable for p in pids)
+    assert a.free_pages == 1
+    # an eviction-forcing admission cannot recycle a pinned page:
+    # 2 wanted > 1 free -> refused, nothing allocated
+    assert a.admit(1, [7] * (2 * PS), 2 * PS) is None
+    # while pinned, the audit must trip: a nonzero refcount with no
+    # slot holding the page is exactly what the assert exists to catch
+    with pytest.raises(AssertionError):
+        a.check_invariants()
+    a.unpin(pids)
+    # balance restored: warm, evictable, audit passes
+    assert all(a._ref[p] == 0 and p in a._evictable for p in pids)
+    a.check_invariants()
+    assert a.admit(1, [7] * (2 * PS), 2 * PS) == 0  # now they recycle
+    a.check_invariants()
+
+
 def test_fuzz_invariants_random_workload():
     rng = np.random.RandomState(0)
     a = PrefixCachingAllocator(num_pages=24, page_size=PS,

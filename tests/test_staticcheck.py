@@ -53,10 +53,10 @@ EXPECTED_RULES = {
 #: exact counts (not >= 1) so a weakened predicate that still catches
 #: SOME sites — the mutcheck analyzer mutants — fails loudly.
 POSITIVE_COUNTS = {
-    "BTF001": 3,
+    "BTF001": 4,
     "BTF002": 5,
     "BTF003": 9,
-    "BTF004": 5,
+    "BTF004": 7,
     "BTF005": 7,
     "BTF006": 3,
 }

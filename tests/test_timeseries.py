@@ -627,6 +627,59 @@ def test_dashboard_subprocess_smoke(tmp_path):
     assert bad.returncode == 2 and "error:" in bad.stderr
 
 
+def test_dashboard_scale_annotations_and_tier_panel(tmp_path):
+    """--flightrecorder overlays kind=scale events as markers + a
+    listing, and kv_tier_* signals render as their own panel with the
+    hit rate on top (ISSUE 17)."""
+    dash = str(REPO / "tools" / "dashboard.py")
+    rec = SignalRecorder(interval_s=1e-9)
+    for i in range(10):
+        rec.sample({"queue_depth": float(i),
+                    "kv_tier_hit_rate": 0.1 * i,
+                    "kv_tier_pages_saved_total": float(2 * i)},
+                   t_wall=100.0 + i)
+    ts = tmp_path / "ts.json"
+    ts.write_text(json.dumps(rec.dump()))
+    fr = tmp_path / "fr.json"
+    fr.write_text(json.dumps({"enabled": True, "events": [
+        {"seq": 1, "t_wall": 103.0, "kind": "scale", "tier": "decode",
+         "direction": "up", "reason": "signal_high",
+         "n_before": 1, "n_after": 2},
+        {"seq": 2, "t_wall": 104.0, "kind": "tick"},  # not a scale
+        {"seq": 3, "t_wall": 108.0, "kind": "scale", "tier": "decode",
+         "direction": "down", "reason": "signal_low",
+         "n_before": 2, "n_after": 1},
+    ]}))
+
+    out = subprocess.run(
+        [sys.executable, dash, str(ts), "--flightrecorder", str(fr)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.count('class="scale"') >= 2  # both in-window marks
+    assert "2 scale event(s)" in out.stdout
+    assert "decode up (signal_high) 1 -&gt; 2" in out.stdout
+    # the tier panel exists and leads with the hit rate
+    assert "<h3 class='panel'>kv tier</h3>" in out.stdout
+    assert (out.stdout.index("kv_tier_hit_rate")
+            < out.stdout.index("kv_tier_pages_saved_total"))
+
+    txt = subprocess.run(
+        [sys.executable, dash, str(ts), "--flightrecorder", str(fr),
+         "--text"],
+        capture_output=True, text=True, timeout=60)
+    assert txt.returncode == 0, txt.stderr
+    assert "scale events:" in txt.stdout
+    assert "+3.0s decode up (signal_high) 1 -> 2" in txt.stdout
+    assert "+8.0s decode down (signal_low) 2 -> 1" in txt.stdout
+    assert "-- kv tier --" in txt.stdout
+
+    bad = subprocess.run(
+        [sys.executable, dash, str(ts), "--flightrecorder",
+         str(tmp_path / "nope.json")],
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 2 and "error:" in bad.stderr
+
+
 def test_butterfly_dash_cli(tmp_path, capsys):
     from butterfly_tpu.serve.cli import main
     rep = _replica_dump_file(tmp_path)

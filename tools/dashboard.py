@@ -17,10 +17,18 @@ expectation — the honesty line saying how much of the window the ring
 actually covers). ``--text`` renders the same series as unicode
 sparklines for terminals.
 
+``--flightrecorder fr.json`` (a saved ``/debug/flightrecorder`` body)
+overlays the autoscaler's scale decisions as dashed vertical markers on
+every sparkline and lists them in their own section — so a queue-depth
+spike can be read against the grow that answered it. KV-tier signals
+(``kv_tier_*``, led by the hit rate) render as their own panel per
+source instead of alphabetically interleaved with the core signals.
+
 stdlib-only (no jax, no numpy): runs anywhere, like tick_report.py.
 
 Usage:  curl -s host:8000/debug/timeseries > ts.json
-        python tools/dashboard.py ts.json --out dash.html
+        curl -s host:9100/debug/flightrecorder > fr.json
+        python tools/dashboard.py ts.json --flightrecorder fr.json --out dash.html
         python tools/dashboard.py ts.json --text
         butterfly dash ts.json --text
 """
@@ -45,6 +53,37 @@ def load_dump(path: str) -> dict:
             f"with a 'samples' list — /debug/timeseries or "
             f"/fleet/timeseries)")
     return dump
+
+
+def load_scale_events(path: str) -> List[dict]:
+    """kind == "scale" events out of a saved flight-recorder body
+    (either the ring's ``dump()`` object or a bare event list)."""
+    with open(path) as f:
+        body = json.load(f)
+    events = body.get("events", body) if isinstance(body, dict) else body
+    if not isinstance(events, list):
+        raise ValueError(
+            f"{path} is not a flight-recorder dump (expected an "
+            f"'events' list — /debug/flightrecorder)")
+    return [e for e in events
+            if isinstance(e, dict) and e.get("kind") == "scale"]
+
+
+#: signals that belong to the host-KV-tier panel, hit rate first
+_TIER_PREFIX = "kv_tier_"
+
+
+def split_tier_signals(names: List[str]) -> Tuple[List[str], List[str]]:
+    """(core, tier) partition of a source's signal names; the tier
+    list leads with kv_tier_hit_rate so the headline ratio sits on
+    top of its own panel."""
+    core = sorted(n for n in names if not n.startswith(_TIER_PREFIX))
+    tier = sorted(n for n in names if n.startswith(_TIER_PREFIX))
+    lead = _TIER_PREFIX + "hit_rate"
+    if lead in tier:
+        tier.remove(lead)
+        tier.insert(0, lead)
+    return core, tier
 
 
 def is_fleet(dump: dict) -> bool:
@@ -115,26 +154,46 @@ def sparkline(vals: List[float], width: int = 48) -> str:
         for v in vals)
 
 
-def render_text(dump: dict) -> str:
+def _scale_line(e: dict, t0: float) -> str:
+    return (f"+{float(e.get('t_wall', 0.0)) - t0:.1f}s "
+            f"{e.get('tier', '?')} {e.get('direction', '?')} "
+            f"({e.get('reason', '?')}) "
+            f"{e.get('n_before', '?')} -> {e.get('n_after', '?')}")
+
+
+def render_text(dump: dict, scales: Optional[List[dict]] = None) -> str:
     grouped = collect(dump)
     alerts = list(dump.get("alerts", ()))
+    scales = scales or []
     lines = []
     kind = "fleet" if is_fleet(dump) else "replica"
     lines.append(f"{kind} timeseries: "
                  f"{len(dump.get('samples', ()))} sample(s), "
                  f"{sum(len(sig) for sig in grouped.values())} series, "
-                 f"{len(alerts)} alert(s)")
+                 f"{len(alerts)} alert(s), "
+                 f"{len(scales)} scale event(s)")
     for src in sorted(grouped):
         if src:
             lines.append("")
             lines.append(f"== {src} ==")
-        for name in sorted(grouped[src]):
-            series = grouped[src][name]
-            st = stats(series)
-            lines.append(
-                f"{name:>28} {sparkline([v for _, v in series])} "
-                f"min {st['min']:g}  mean {st['mean']:g}  "
-                f"max {st['max']:g}  last {st['last']:g}")
+        core, tier = split_tier_signals(list(grouped[src]))
+        for group, names in (("", core), ("kv tier", tier)):
+            if group and names:
+                lines.append(f"{'-- ' + group + ' --':>28}")
+            for name in names:
+                series = grouped[src][name]
+                st = stats(series)
+                lines.append(
+                    f"{name:>28} {sparkline([v for _, v in series])} "
+                    f"min {st['min']:g}  mean {st['mean']:g}  "
+                    f"max {st['max']:g}  last {st['last']:g}")
+    if scales:
+        samples = dump.get("samples", ())
+        t0 = sample_time(samples[0]) if samples else 0.0
+        lines.append("")
+        lines.append("scale events:")
+        for e in scales:
+            lines.append(f"  {_scale_line(e, t0)}")
     if alerts:
         lines.append("")
         lines.append("alerts:")
@@ -162,7 +221,8 @@ def render_text(dump: dict) -> str:
 # -- HTML rendering -----------------------------------------------------------
 
 def _svg_sparkline(series: List[Tuple[float, float]],
-                   alert_ts: List[float]) -> str:
+                   alert_ts: List[float],
+                   scale_ts: Optional[List[float]] = None) -> str:
     ts = [t for t, _ in series]
     vals = [v for _, v in series]
     t0, t1 = min(ts), max(ts)
@@ -181,6 +241,9 @@ def _svg_sparkline(series: List[Tuple[float, float]],
     marks = "".join(
         f'<line x1="{x(t):.1f}" y1="0" x2="{x(t):.1f}" y2="{h}" '
         f'class="alert"/>' for t in alert_ts if t0 <= t <= t1)
+    marks += "".join(
+        f'<line x1="{x(t):.1f}" y1="0" x2="{x(t):.1f}" y2="{h}" '
+        f'class="scale"/>' for t in (scale_ts or ()) if t0 <= t <= t1)
     return (f'<svg width="{w}" height="{h}" viewBox="0 0 {w} {h}">'
             f'{marks}<polyline points="{pts}" fill="none" '
             f'class="line"/></svg>')
@@ -196,46 +259,64 @@ td.stat { font-family: ui-monospace, monospace; color: #555;
           white-space: nowrap; }
 svg .line { stroke: #2061c4; stroke-width: 1.5; }
 svg .alert { stroke: #d43a2f; stroke-width: 1; }
-ul.alerts li { font-family: ui-monospace, monospace; }
+svg .scale { stroke: #1e9e63; stroke-width: 1; stroke-dasharray: 3 2; }
+ul.alerts li, ul.scales li { font-family: ui-monospace, monospace; }
+h3.panel { font-size: 0.95em; margin: 0.6em 0 0; color: #555; }
 .sev-page { color: #d43a2f; font-weight: bold; }
 .sev-warn { color: #b07a00; font-weight: bold; }
 footer { margin-top: 2em; color: #777; }
 """
 
 
-def render_html(dump: dict) -> str:
+def render_html(dump: dict, scales: Optional[List[dict]] = None) -> str:
     grouped = collect(dump)
     alerts = list(dump.get("alerts", ()))
+    scales = scales or []
+    scale_ts = [float(e.get("t_wall", 0.0)) for e in scales]
     kind = "fleet" if is_fleet(dump) else "replica"
     out = ["<!doctype html><html><head><meta charset='utf-8'>",
            f"<title>butterfly {kind} timeseries</title>",
            f"<style>{_CSS}</style></head><body>",
            f"<h1>butterfly {kind} timeseries</h1>",
            f"<p>{len(dump.get('samples', ()))} sample(s) &middot; "
-           f"{len(alerts)} alert(s) &middot; schema "
+           f"{len(alerts)} alert(s) &middot; "
+           f"{len(scales)} scale event(s) &middot; schema "
            f"{html.escape(str(dump.get('schema', '?')))}</p>"]
     for src in sorted(grouped):
         if src:
             out.append(f"<h2>{html.escape(src)}</h2>")
-        out.append("<table class='signals'>")
-        for name in sorted(grouped[src]):
-            series = grouped[src][name]
-            st = stats(series)
-            alert_ts = [float(a.get("t_fleet", a.get("t_wall", 0.0)))
-                        for a in alerts
-                        if a.get("signal") == name
-                        and (not src
-                             or str(a.get("source", "")) in
-                             (src, src.replace("scrape:", "")))]
-            out.append(
-                "<tr>"
-                f"<td class='name'>{html.escape(name)}</td>"
-                f"<td>{_svg_sparkline(series, alert_ts)}</td>"
-                f"<td class='stat'>min {st['min']:g}<br>"
-                f"mean {st['mean']:g}</td>"
-                f"<td class='stat'>max {st['max']:g}<br>"
-                f"last {st['last']:g}</td></tr>")
-        out.append("</table>")
+        core, tier = split_tier_signals(list(grouped[src]))
+        for group, names in (("", core), ("kv tier", tier)):
+            if not names:
+                continue
+            if group:
+                out.append(f"<h3 class='panel'>{group}</h3>")
+            out.append("<table class='signals'>")
+            for name in names:
+                series = grouped[src][name]
+                st = stats(series)
+                alert_ts = [float(a.get("t_fleet", a.get("t_wall", 0.0)))
+                            for a in alerts
+                            if a.get("signal") == name
+                            and (not src
+                                 or str(a.get("source", "")) in
+                                 (src, src.replace("scrape:", "")))]
+                out.append(
+                    "<tr>"
+                    f"<td class='name'>{html.escape(name)}</td>"
+                    f"<td>{_svg_sparkline(series, alert_ts, scale_ts)}</td>"
+                    f"<td class='stat'>min {st['min']:g}<br>"
+                    f"mean {st['mean']:g}</td>"
+                    f"<td class='stat'>max {st['max']:g}<br>"
+                    f"last {st['last']:g}</td></tr>")
+            out.append("</table>")
+    if scales:
+        samples = dump.get("samples", ())
+        t0 = sample_time(samples[0]) if samples else 0.0
+        out.append("<h2>scale events</h2><ul class='scales'>")
+        for e in scales:
+            out.append(f"<li>{html.escape(_scale_line(e, t0))}</li>")
+        out.append("</ul>")
     if alerts:
         out.append("<h2>alerts</h2><ul class='alerts'>")
         for a in alerts:
@@ -272,13 +353,21 @@ def main(argv=None) -> int:
     ap.add_argument("--text", action="store_true",
                     help="unicode sparklines for terminals instead "
                          "of HTML")
+    ap.add_argument("--flightrecorder", metavar="FR_JSON",
+                    help="a saved /debug/flightrecorder body: its "
+                         "kind=scale events become dashed vertical "
+                         "annotations on every sparkline plus a "
+                         "'scale events' listing")
     args = ap.parse_args(argv)
     try:
         dump = load_dump(args.dump)
+        scales = (load_scale_events(args.flightrecorder)
+                  if args.flightrecorder else [])
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    body = render_text(dump) if args.text else render_html(dump)
+    body = (render_text(dump, scales) if args.text
+            else render_html(dump, scales))
     if args.out and not args.text:
         with open(args.out, "w") as f:
             f.write(body)

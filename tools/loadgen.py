@@ -284,6 +284,7 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
              deadline_ms: Optional[float] = None,
              priority: Optional[str] = None,
              speculative: Optional[bool] = None,
+             arrival: Optional[str] = None,
              scrape: bool = True) -> Dict:
     """Drive `url` closed-loop; returns aggregate stats.
 
@@ -297,9 +298,24 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     ('interactive'/'batch'; batch sheds first under load). Outcome /
     SLO semantics live in `Collector`; the summary additionally carries
     the post-run server-side counters under ``server``
-    (`scrape_server_counters`)."""
+    (`scrape_server_counters`).
+
+    `arrival` (a workload/arrivals.py spec — ``ramp:2:50:10``,
+    ``burst:20:0.5:2``, ``poisson:8``) switches the lanes from
+    closed-loop to a SCHEDULED offered load: the clients*requests
+    arrival offsets are drawn once from the process and dealt round-
+    robin across the lanes, and each lane sleeps until a request's
+    offset before firing. Per-lane it is semi-open — a response that
+    overruns the gap delays that lane's next shot but nobody else's —
+    which is what ramps the pressure an elastic fleet has to absorb."""
     prefix = shared_prefix(shared_len, seed, vocab)
     col = Collector(slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
+    offsets = None
+    if arrival is not None:
+        _, arrivals, _ = _workload_modules()
+        offsets = arrivals.parse_arrival(arrival).times(
+            clients * requests_per_client, seed)
+    t_start = time.monotonic()
 
     def one_client(cid: int) -> None:
         rng = random.Random(seed * 1000 + cid)
@@ -319,10 +335,16 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
                 payload["priority"] = priority
             if speculative is not None:
                 payload["speculative"] = speculative
+            if offsets is not None:
+                # round-robin deal keeps each lane's schedule ascending
+                # while spreading a ramp's dense tail across all lanes
+                wait = t_start + offsets[cid + clients * i] \
+                    - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
             fire_one(url, path, payload, timeout, col,
                      label=f"client{cid}#{i}", shared=is_shared)
 
-    t_start = time.monotonic()
     threads = [threading.Thread(target=one_client, args=(c,))
                for c in range(clients)]
     for t in threads:
@@ -330,6 +352,8 @@ def run_load(url: str, clients: int = 4, requests_per_client: int = 8,
     for t in threads:
         t.join()
     out = col.summary(time.monotonic() - t_start)
+    if arrival is not None:
+        out["arrival"] = arrival
     if scrape:
         out["server"] = scrape_server_counters(url)
     return out
@@ -373,7 +397,8 @@ def run_fleet_soak(url: str, clients: int = 4,
                    slo_itl_ms: Optional[float] = None,
                    deadline_ms: Optional[float] = None,
                    priority: Optional[str] = None,
-                   speculative: Optional[bool] = None) -> Dict:
+                   speculative: Optional[bool] = None,
+                   arrival: Optional[str] = None) -> Dict:
     """Fleet soak: closed-loop load against a control plane WHILE every
     replica is rolled through drain -> (restart) -> undrain, one at a
     time. The pass/fail property is the router tier's: zero dropped
@@ -400,7 +425,8 @@ def run_fleet_soak(url: str, clients: int = 4,
             tail_len=tail_len, max_tokens=max_tokens, seed=seed,
             vocab=vocab, timeout=timeout, slo_ttft_ms=slo_ttft_ms,
             slo_itl_ms=slo_itl_ms, deadline_ms=deadline_ms,
-            priority=priority, speculative=speculative))
+            priority=priority, speculative=speculative,
+            arrival=arrival))
 
     t = threading.Thread(target=_load)
     t.start()
@@ -469,10 +495,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="OPEN-LOOP mode: replay a saved JSONL trace "
                          "(butterfly workload generate / --save) with "
                          "absolute-time fidelity")
-    ap.add_argument("--arrival", default="poisson:8",
-                    help="arrival process for --workload: poisson:<rate>"
+    ap.add_argument("--arrival", default=None,
+                    help="arrival process: poisson:<rate>"
                          ", burst:<rate_on>:<mean_on_s>:<mean_off_s>"
-                         "[:<rate_off>], or ramp:<r0>:<r1>:<ramp_s>")
+                         "[:<rate_off>], or ramp:<r0>:<r1>:<ramp_s>. "
+                         "With --workload this paces the open-loop "
+                         "replay (default poisson:8); in the default "
+                         "and --soak modes it switches the client "
+                         "lanes from closed-loop to the scheduled "
+                         "offered load (e.g. --arrival ramp:2:50:10 "
+                         "to ramp pressure on an elastic fleet)")
     ap.add_argument("--n", type=int, default=32,
                     help="total requests to generate for --workload")
     ap.add_argument("--speed", type=float, default=1.0,
@@ -540,7 +572,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 deadline_ms=args.deadline_ms)
             specs = wl.sample(args.n, args.seed)
             arrivals.assign_arrivals(
-                specs, arrivals.parse_arrival(args.arrival), args.seed)
+                specs,
+                arrivals.parse_arrival(args.arrival or "poisson:8"),
+                args.seed)
             if args.priority is not None:
                 for s in specs:
                     s.priority = args.priority
@@ -549,7 +583,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     s.speculative = args.speculative == "on"
             if args.save:
                 replay.save_trace(args.save, specs, workload=wl,
-                                  arrival=args.arrival, seed=args.seed)
+                                  arrival=args.arrival or "poisson:8",
+                                  seed=args.seed)
         stats = replay.replay_trace(
             args.url, specs, path=args.path, timeout=args.timeout,
             speed=args.speed, slo_ttft_ms=args.slo_ttft_ms,
@@ -567,7 +602,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                deadline_ms=args.deadline_ms,
                                priority=args.priority,
                                speculative=(None if args.speculative is None
-                                            else args.speculative == "on"))
+                                            else args.speculative == "on"),
+                               arrival=args.arrival)
     else:
         stats = run_load(args.url, clients=args.clients,
                          requests_per_client=args.requests,
@@ -580,7 +616,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          deadline_ms=args.deadline_ms,
                          priority=args.priority,
                          speculative=(None if args.speculative is None
-                                      else args.speculative == "on"))
+                                      else args.speculative == "on"),
+                         arrival=args.arrival)
     if args.json:
         print(json.dumps(stats, indent=2))
     else:

@@ -255,6 +255,15 @@ MUTANTS = [
      "if h in consumed or h in new:",
      "if h in consumed and h in new:",
      ["tests/test_staticcheck.py"], {}),
+    # elastic fleet (ISSUE 17): invert the scale-down hysteresis guard —
+    # a shrink would be HELD only after the quiet window and allowed
+    # inside it, so a grow->shrink->grow flap pays the warmup on every
+    # cycle. Killed by the autoscaler unit grid (the hysteresis test
+    # pins both branches: held inside the window, allowed after it).
+    ("butterfly_tpu/fleet/autoscale.py",
+     "if now - last < pol.cooldown_down_s:",
+     "if now - last >= pol.cooldown_down_s:",
+     ["tests/test_autoscale.py"], {}),
 ]
 
 

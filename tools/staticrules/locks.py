@@ -86,7 +86,7 @@ class LockDisciplineRule(Rule):
                  "instrument writes are locked")
     scope = ("butterfly_tpu/serve", "butterfly_tpu/router",
              "butterfly_tpu/fleet", "butterfly_tpu/sched",
-             "butterfly_tpu/obs")
+             "butterfly_tpu/obs", "butterfly_tpu/cache")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         yield from self._check_acquires(ctx)
