@@ -226,6 +226,20 @@ def main() -> int:
                         prefill_max_batch=4, kv_quant="none",
                         grid=[(1, 1), (1, 2), (4, 1), (4, 2)])
     serving.update(run_mixed_benchmark(model, params, **mixed_kw))
+    # Unified mixed dispatch (ISSUE 18) acceptance pair as explicit
+    # deltas: admission barrier count (fused ≈ 0 vs the alternating
+    # reference's one per mid-flight arrival) and the ITL-p95 change
+    # that buys at heavy prompt load (negative = fused improves the
+    # tail). The raw `_alt` pairs ride along from the benchmark fns.
+    for phase, itl, bar in (
+            ("serving", "itl_req_mean_p95", "serving_admission_barriers"),
+            ("mixed", "mixed_itl_req_mean_p95", "mixed_admission_barriers")):
+        if itl in serving and itl + "_alt" in serving:
+            serving[phase + "_itl_p95_delta"] = \
+                serving[itl] - serving[itl + "_alt"]
+        if bar in serving and bar + "_alt" in serving:
+            serving[phase + "_admission_barriers_delta"] = \
+                serving[bar] - serving[bar + "_alt"]
     toks_per_sec_chip = stats["tokens_per_sec_per_chip"]
 
     vs = 1.0

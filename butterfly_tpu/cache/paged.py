@@ -144,6 +144,15 @@ def write_paged_layer(k_pages: jax.Array, v_pages: jax.Array,
     as floats and are quantized per-vector on the way in. Returns
     (k_pages, v_pages, k_scale_pages, v_scale_pages) — scales None when
     the pool is float.
+
+    Mixed-dispatch contract (ISSUE 18): inside a fused mixed block the
+    per-slot `start` is the slot's live cursor/length carry and T is
+    the chunk width C — a decode-phase lane writes its one token at
+    start=length with the chunk tail masked inactive, a prefill-phase
+    lane writes its next C prompt tokens at start=cursor. Both reduce
+    to exactly this scatter; no new write primitive exists for the
+    fused path, which is why fused and alternating pools are
+    bit-identical.
     """
     Pp, Kv, page, H = k_pages.shape
     B, T = k.shape[0], k.shape[1]
@@ -344,9 +353,14 @@ def flush_paged_window(cache: PagedKVCache, window: KVWindow, win_len):
     Entries past win_len (dead-step repeats, rejected speculative
     drafts) route to the null page exactly like write_paged_layer's
     inactive-slot writes — the flushed pool never holds them, which is
-    what makes spec rollback exact for flushed state. Returns
-    (cache with lengths advanced by win_len, zeroed win_len, flushed
-    token count [scalar]).
+    what makes spec rollback exact for flushed state. Under mixed
+    dispatch (ISSUE 18) prefill-chunk K/V stages through this same
+    window: win_len for a prefill-phase slot grows by chunk widths
+    rather than 1 per step, and an admission seeds the slot's win_len
+    to 0 (the freed slot was flushed at its drain), so a fused block's
+    staged prompt entries can never interleave with a predecessor's.
+    Returns (cache with lengths advanced by win_len, zeroed win_len,
+    flushed token count [scalar]).
     """
     L, Pp, Kv, page, H = cache.k_pages.shape
     S = win_len.shape[0]

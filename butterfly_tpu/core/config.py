@@ -192,6 +192,31 @@ class RuntimeConfig:
                                       # next power of two (clamped here)
                                       # so at most log2(this)+1 batch
                                       # shapes ever compile per T bucket
+    mixed_dispatch: bool = True       # fused mixed dispatch: each tick's
+                                      # jitted block carries BOTH phases —
+                                      # decode/spec slots advance tokens
+                                      # while freshly admitted slots chew
+                                      # budget-bounded prefill chunks in
+                                      # the same scan (per-slot phase
+                                      # masks + chunk cursors riding the
+                                      # carry), retiring admission-cause
+                                      # drain barriers as a class. False
+                                      # = the alternating prefill/decode
+                                      # path, the parity reference.
+                                      # Continuous scheduler only; falls
+                                      # back to alternating for stateful
+                                      # (model) draft sources
+    prefill_inline_budget: int = 32   # mixed dispatch: max prefill
+                                      # tokens chewed per scan STEP
+                                      # across all prefilling slots —
+                                      # the ITL-tail knob. Each
+                                      # prefilling slot consumes a
+                                      # C-token chunk per step; this
+                                      # bounds how many slots may be in
+                                      # prefill phase concurrently
+                                      # (budget // C), trading admission
+                                      # throughput against decode-slot
+                                      # step latency
     page_size: int = 16               # paged-KV tokens per block
     num_pages: int = 0                # 0 => derive from max_batch/max_seq
     scheduler: str = "continuous"     # "continuous" (chunked-prefill/decode

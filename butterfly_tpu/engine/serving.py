@@ -29,9 +29,20 @@ recompile (SURVEY.md §7 "hard parts"):
   back-to-back while the host schedules; a dead slot's carry stays
   frozen at its stop id, which starts it dead in every later block.
 
+* `mixed_block` (ISSUE 18): the decode/spec block generalized to carry
+  BOTH phases — each scan step, decode-phase slots advance one token
+  (or one speculative round) while prefill-phase slots chew a C-token
+  chunk of their prompt through the warm multi-token path, with the
+  first token sampled on device at the step a slot's prefill completes.
+  Phase is a pure function of the per-slot chunk cursor riding the
+  carry (`cursor < plen`), so admission becomes a host-side cursor/
+  buffer edit between dispatches instead of a drain barrier + separate
+  prefill dispatch (the admission-cause barrier class this retires).
+
 Parity contract: tests/test_sched.py and tests/test_serving_mesh.py check
 token-for-token equality with InferenceEngine.generate on the contiguous
-cache (single-device and meshed respectively).
+cache (single-device and meshed respectively); tests/test_mixed_dispatch.py
+pins the mixed block token-for-token against the alternating path.
 """
 from __future__ import annotations
 
@@ -321,6 +332,16 @@ class ServingEngine:
         self._win_hwm = 0          # host upper bound on staged entries
         self._decode_win_blocks: Dict[int, object] = {}
         self._spec_win_blocks: Dict[int, object] = {}
+        # Mixed blocks (ISSUE 18): the decode scan generalized with
+        # prefill lanes, keyed (k, C) — the chunk width is a static
+        # shape, and the scheduler collapses C to 1 whenever no slot is
+        # in prefill phase, so the steady-state program is exactly the
+        # decode block's shape. Spec-mixed programs key on rounds alone
+        # (their C is pinned to gamma + 1).
+        self._mixed_blocks: Dict[Tuple[int, int], object] = {}
+        self._mixed_win_blocks: Dict[Tuple[int, int], object] = {}
+        self._mixed_spec_blocks: Dict[int, object] = {}
+        self._mixed_spec_win_blocks: Dict[int, object] = {}
         self._flush = jax.jit(flush_paged_window, donate_argnums=(0, 2))
         # Fused speculative blocks (scheduler speculative mode): one
         # jitted program per round count, like _decode_blocks. The
@@ -661,6 +682,95 @@ class ServingEngine:
         self.cache = cache
         return block, final
 
+    @property
+    def mixed_dispatch_ready(self) -> bool:
+        """Can the scheduler route this engine through mixed blocks?
+        RuntimeConfig.mixed_dispatch on AND a stateless draft source —
+        a stateful ("model") source's admission reseed hook
+        (draft_prefill) is a host-side call that needs the drain
+        barrier mixed dispatch deletes, so it keeps the alternating
+        path."""
+        return bool(self.runtime.mixed_dispatch) \
+            and not self._draft_stateful
+
+    def _mixed_block_prog(self, k: int, C: int):
+        prog = self._mixed_blocks.get((k, C))
+        if prog is None:
+            prog = jax.jit(
+                partial(_mixed_scan, self.cfg, self._fwd, k, C,
+                        use_kernel=self._use_kernels),
+                static_argnums=(10, 11), donate_argnums=(2, 3))
+            self._mixed_blocks[(k, C)] = prog
+        return prog
+
+    def _mixed_block_win_prog(self, k: int, C: int):
+        """Windowed twin of _mixed_block_prog: cursor, cache, window
+        buffer, and staged count are all donated — the pool passes
+        through unmodified (aliased); the cursor is the NEW carry the
+        scheduler must rebind every dispatch (BTF002 contract)."""
+        prog = self._mixed_win_blocks.get((k, C))
+        if prog is None:
+            prog = jax.jit(
+                partial(_mixed_scan_win, self.cfg, k, C,
+                        use_kernel=self._use_kernels),
+                static_argnums=(12, 13), donate_argnums=(2, 3, 4, 5))
+            self._mixed_win_blocks[(k, C)] = prog
+        return prog
+
+    def mixed_block_async(self, tokens, cursor, pbuf, plen,
+                          active: np.ndarray, temps: np.ndarray,
+                          stops: np.ndarray, budgets, key: jax.Array,
+                          k: int, C: int):
+        """Dispatch ONE fused k-step MIXED block, no host sync: decode
+        slots advance a token per step while prefill-phase slots chew a
+        C-token chunk of their `pbuf` row per step (_mixed_scan), in a
+        single jitted scan covering both phases — admission no longer
+        costs a drain barrier, just the host-side cursor/pbuf/table
+        edits the scheduler does between dispatches.
+
+        `cursor` [S] is the device-resident chunk-cursor carry
+        (DONATED — rebind from the result, exactly like the cache);
+        `pbuf` [S, H] the prompt rows (read-only, host-rebound on
+        admission); `plen` [S] each slot's prompt length (a slot is in
+        prefill phase while cursor < plen). Returns (block [k, S],
+        valid [k, S], final [S], cursor): stacked step tokens plus the
+        validity mask the drain walks (a prefill step emits only at
+        completion), and the chain/cursor carries for the next
+        dispatch.
+
+        kv_write_combine: stages through the engine window like
+        decode_block_async — worst case k * C staged entries (prefill
+        lanes advance win_len by their real chunk length; filler past
+        it is never flushed)."""
+        self._sync_table()
+        if self._window_mode:
+            self._ensure_window(k * C)
+            with self._mesh_ctx():
+                block, valid, final, cursor, cache, window, wlen = \
+                    self._mixed_block_win_prog(k, C)(
+                        self.params, jnp.asarray(tokens), cursor,
+                        self.cache, self._kv_window, self._win_len,
+                        pbuf, jnp.asarray(plen, jnp.int32),
+                        jnp.asarray(active, bool), jnp.asarray(temps),
+                        jnp.asarray(stops, jnp.int32),
+                        jnp.asarray(budgets, jnp.int32),
+                        self.runtime_top_k, self.runtime_top_p, key)
+            self.cache, self._kv_window, self._win_len = cache, window, wlen
+            self._win_dirty = True
+            self._win_hwm += k * C
+            return block, valid, final, cursor
+        with self._mesh_ctx():
+            block, valid, final, cursor, cache = \
+                self._mixed_block_prog(k, C)(
+                    self.params, jnp.asarray(tokens), cursor, self.cache,
+                    pbuf, jnp.asarray(plen, jnp.int32),
+                    jnp.asarray(active, bool), jnp.asarray(temps),
+                    jnp.asarray(stops, jnp.int32),
+                    jnp.asarray(budgets, jnp.int32),
+                    self.runtime_top_k, self.runtime_top_p, key)
+        self.cache = cache
+        return block, valid, final, cursor
+
     def read_pages(self, pids: list[int]) -> Tuple[np.ndarray, np.ndarray,
                                                    Optional[np.ndarray],
                                                    Optional[np.ndarray]]:
@@ -818,6 +928,83 @@ class ServingEngine:
                     jnp.asarray(spec_mask, bool))
         self.cache, self._draft_state = cache, dstate
         return toks, valid, hist, hist_len, rem
+
+    def _mixed_spec_prog(self, rounds: int):
+        prog = self._mixed_spec_blocks.get(rounds)
+        if prog is None:
+            rt = self.runtime
+            prog = jax.jit(
+                partial(_mixed_spec_scan, self.cfg, self._fwd, rounds,
+                        rt.speculative_gamma, rt.speculative_ngram,
+                        self._draft_src, use_kernel=self._use_kernels),
+                static_argnums=(10, 11), donate_argnums=(1, 3, 5))
+            self._mixed_spec_blocks[rounds] = prog
+        return prog
+
+    def _mixed_spec_win_prog(self, rounds: int):
+        """Windowed twin of _mixed_spec_prog: donates the history and
+        cursor carries plus the cache / window / staged-count triple.
+        No draft-state slot — mixed dispatch is gated to stateless
+        sources (mixed_dispatch_ready)."""
+        prog = self._mixed_spec_win_blocks.get(rounds)
+        if prog is None:
+            rt = self.runtime
+            prog = jax.jit(
+                partial(_mixed_spec_scan_win, self.cfg, rounds,
+                        rt.speculative_gamma, rt.speculative_ngram,
+                        self._draft_src, use_kernel=self._use_kernels),
+                static_argnums=(12, 13), donate_argnums=(1, 3, 5, 6, 7))
+            self._mixed_spec_win_blocks[rounds] = prog
+        return prog
+
+    def mixed_spec_block_async(self, hist, hist_len, cursor, plen,
+                               active: np.ndarray, temps: np.ndarray,
+                               stops: np.ndarray, budgets,
+                               spec_mask: np.ndarray, key: jax.Array,
+                               rounds: int):
+        """Dispatch ONE fused speculative MIXED block — spec_block_async
+        with prefill lanes (_mixed_spec_scan). The history carry
+        doubles as the prompt buffer (a freshly admitted slot's hist
+        row holds its full prompt, hist_len == prompt length), so the
+        only new operands are the donated chunk-cursor carry and the
+        per-slot prompt lengths. Returns (toks [rounds, S, C], valid
+        [rounds, S, C], hist, hist_len, rem, cursor) — a completing
+        prefill slot's first token arrives as a single valid entry at
+        column 0 of its completion round, so the drain needs no new
+        unpacking. Stateless draft sources only (mixed_dispatch_ready).
+        """
+        self._sync_table()
+        if self._window_mode:
+            C = self.runtime.speculative_gamma + 1
+            self._ensure_window(rounds * C)
+            with self._mesh_ctx():
+                (toks, valid, hist, hist_len, rem, cursor, cache,
+                 window, wlen) = self._mixed_spec_win_prog(rounds)(
+                        self.params, hist,
+                        jnp.asarray(hist_len, jnp.int32), cursor,
+                        jnp.asarray(plen, jnp.int32), self.cache,
+                        self._kv_window, self._win_len,
+                        jnp.asarray(active, bool), jnp.asarray(temps),
+                        jnp.asarray(stops, jnp.int32),
+                        jnp.asarray(budgets, jnp.int32),
+                        self.runtime_top_k, self.runtime_top_p, key,
+                        jnp.asarray(spec_mask, bool))
+            self.cache, self._kv_window, self._win_len = cache, window, wlen
+            self._win_dirty = True
+            self._win_hwm += rounds * C
+            return toks, valid, hist, hist_len, rem, cursor
+        with self._mesh_ctx():
+            toks, valid, hist, hist_len, rem, cursor, cache = \
+                self._mixed_spec_prog(rounds)(
+                    self.params, hist, jnp.asarray(hist_len, jnp.int32),
+                    cursor, jnp.asarray(plen, jnp.int32), self.cache,
+                    jnp.asarray(active, bool),
+                    jnp.asarray(temps), jnp.asarray(stops, jnp.int32),
+                    jnp.asarray(budgets, jnp.int32),
+                    self.runtime_top_k, self.runtime_top_p, key,
+                    jnp.asarray(spec_mask, bool))
+        self.cache = cache
+        return toks, valid, hist, hist_len, rem, cursor
 
     # static sampling knobs (per-slot temps are dynamic)
     @property
@@ -1143,3 +1330,342 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
             jnp.arange(rounds, dtype=jnp.int32))
     return (toks_blk, valid_blk, hist, hist_len, rem, cache, window,
             win_len, dstate)
+
+
+def _mixed_scan(cfg: ModelConfig, fwd, k: int, C: int, params, tokens,
+                cursor, cache: PagedKVCache, pbuf, plen, active, temps,
+                stops, budgets, top_k: int, top_p: float, key,
+                use_kernel: bool = False):
+    """k chained MIXED iterations in ONE lax.scan (ISSUE 18): each
+    step, every slot is in exactly one phase — decode slots advance one
+    token (_decode_scan's semantics, token-for-token) while prefill
+    slots chew a C-token chunk of their prompt-buffer row through the
+    warm multi-token path, the same [S, C] program shape the spec
+    verify runs. Phase is a pure function of the carry: a slot is in
+    prefill phase while cursor < plen. The scheduler seeds cursor at
+    the cached-prefix length on admission and keeps the invariant
+    cursor == the slot's written-token count (cache.lengths), so the
+    forward's per-row position base is exact for both phases.
+
+    A prefill step consumes count = min(C, plen - cursor) real
+    positions; columns past count — and every column past the first of
+    a decode slot, whose chain token rides broadcast across the chunk
+    width — carry filler whose K/V lands past the slot's advanced
+    length. The advance is rolled back to the real count via the
+    lengths-replace pattern (_spec_scan's rollback), and the stale run
+    is rewritten before any query can attend it (write-then-attend):
+    the next step's C-wide write starts exactly at the rolled-back
+    length.
+
+    Emissions: a decode step emits its sampled token; a prefill step
+    emits ONLY at the step its prefill completes — the slot's first
+    token, sampled on device from the chunk's last real column (the
+    same last-position logits the alternating path's gang prefill
+    hands _finish_prefill). valid[i, s] marks block[i, s] as a real
+    emission; the drain walks it like the spec block's validity mask.
+    With no prefill-phase slot in the batch and C == 1 the program
+    degenerates to _decode_scan exactly (same RNG stream fold_in(key,
+    i), same liveness algebra) — the parity grid pins this.
+
+    Returns (block [k, S], valid [k, S], final [S], cursor, cache).
+    """
+    S = tokens.shape[0]
+    H = pbuf.shape[1]
+    ccol = jnp.arange(C)[None, :]
+    has_stop = stops >= 0
+    is_pf0 = cursor < plen
+    # prefill-phase slots skip the chain-token stop check: their
+    # incoming token is prompt filler, not an emission
+    live = active & (budgets > 0) \
+        & jnp.where(has_stop & ~is_pf0, tokens != stops, True)
+
+    def body(carry, i):
+        cur, cursor, cache, live, rem = carry
+        is_pf = cursor < plen
+        count = jnp.where(is_pf, jnp.clip(plen - cursor, 0, C), 0)
+        pchunk = jnp.take_along_axis(
+            pbuf, jnp.clip(cursor[:, None] + ccol, 0, H - 1), axis=1)
+        toks = jnp.where(is_pf[:, None], pchunk,
+                         jnp.broadcast_to(cur[:, None], (S, C)))
+        W = cache.lengths
+        logits, cache = fwd(params, cfg, toks, cache, active=live,
+                            use_kernel=use_kernel)
+        completing = is_pf & (cursor + count >= plen)
+        sidx = jnp.where(is_pf, jnp.clip(count - 1, 0, C - 1), 0)
+        lg = jnp.take_along_axis(logits, sidx[:, None, None],
+                                 axis=1)[:, 0, :]
+        nxt = sample_batched(lg, jax.random.fold_in(key, i), temps,
+                             top_k, top_p)
+        emit = live & (completing | ~is_pf)
+        nxt = jnp.where(emit, nxt, cur)
+        adv = jnp.where(live, jnp.where(is_pf, count, 1), 0)
+        cache = cache._replace(lengths=W + adv)
+        cursor = jnp.where(live & is_pf, cursor + count, cursor)
+        rem = jnp.where(emit, rem - 1, rem)
+        live = live & jnp.where(
+            emit, (rem > 0) & jnp.where(has_stop, nxt != stops, True),
+            True)
+        return (nxt, cursor, cache, live, rem), (nxt, emit)
+
+    (final, cursor, cache, _, _), (block, valid) = lax.scan(
+        body, (tokens, cursor, cache, live, budgets),
+        jnp.arange(k, dtype=jnp.int32))
+    return block, valid, final, cursor, cache
+
+
+def _mixed_scan_win(cfg: ModelConfig, k: int, C: int, params, tokens,
+                    cursor, cache: PagedKVCache, window: KVWindow,
+                    win_len, pbuf, plen, active, temps, stops, budgets,
+                    top_k: int, top_p: float, key,
+                    use_kernel: bool = False):
+    """Write-combined twin of _mixed_scan — phase/emission/RNG
+    semantics are IDENTICAL (the parity grid pins token equality);
+    only the K/V write target differs. A step stages its full C-wide
+    chunk at the slot's win_len and win_len advances by the REAL count
+    only (chunk length for a prefill step, 1 for a decode step, 0
+    dead): filler and dead-step repeats sit past win_len, unattendable
+    and never flushed, and the next step's C-wide stage rewrites them
+    inside the window buffer — the spec window's rollback argument
+    applied to chunk raggedness. The pool stays READ-ONLY; a freshly
+    admitted slot's registered-prefix pages are flushed state by
+    construction (registration happens at drain points, after the
+    flush), so its chunk attends prefix from the pool and its own
+    staged run from the window with no ordering hazard.
+
+    Returns (block [k, S], valid [k, S], final [S], cursor, cache,
+    window, win_len).
+    """
+    S = tokens.shape[0]
+    H = pbuf.shape[1]
+    ccol = jnp.arange(C)[None, :]
+    has_stop = stops >= 0
+    is_pf0 = cursor < plen
+    live = active & (budgets > 0) \
+        & jnp.where(has_stop & ~is_pf0, tokens != stops, True)
+
+    def body(carry, i):
+        cur, cursor, win, wlen, live, rem = carry
+        is_pf = cursor < plen
+        count = jnp.where(is_pf, jnp.clip(plen - cursor, 0, C), 0)
+        pchunk = jnp.take_along_axis(
+            pbuf, jnp.clip(cursor[:, None] + ccol, 0, H - 1), axis=1)
+        toks = jnp.where(is_pf[:, None], pchunk,
+                         jnp.broadcast_to(cur[:, None], (S, C)))
+        logits, win = paged_forward_window(params, cfg, toks, cache,
+                                           win, wlen, active=live,
+                                           use_kernel=use_kernel)
+        completing = is_pf & (cursor + count >= plen)
+        sidx = jnp.where(is_pf, jnp.clip(count - 1, 0, C - 1), 0)
+        lg = jnp.take_along_axis(logits, sidx[:, None, None],
+                                 axis=1)[:, 0, :]
+        nxt = sample_batched(lg, jax.random.fold_in(key, i), temps,
+                             top_k, top_p)
+        emit = live & (completing | ~is_pf)
+        nxt = jnp.where(emit, nxt, cur)
+        adv = jnp.where(live, jnp.where(is_pf, count, 1), 0)
+        wlen = wlen + adv
+        cursor = jnp.where(live & is_pf, cursor + count, cursor)
+        rem = jnp.where(emit, rem - 1, rem)
+        live = live & jnp.where(
+            emit, (rem > 0) & jnp.where(has_stop, nxt != stops, True),
+            True)
+        return (nxt, cursor, win, wlen, live, rem), (nxt, emit)
+
+    (final, cursor, window, win_len, _, _), (block, valid) = lax.scan(
+        body, (tokens, cursor, window, win_len, live, budgets),
+        jnp.arange(k, dtype=jnp.int32))
+    return block, valid, final, cursor, cache, window, win_len
+
+
+def _mixed_spec_scan(cfg: ModelConfig, fwd, rounds: int, gamma: int,
+                     ngram: int, draft_src, params, hist, hist_len,
+                     cursor, plen, cache: PagedKVCache, active, temps,
+                     stops, budgets, top_k: int, top_p: float, key,
+                     spec_mask, use_kernel: bool = False):
+    """Speculative mixed block: _spec_scan generalized with prefill
+    lanes (ISSUE 18). Decode-phase slots run the full draft ->
+    batched-verify -> on-device-accept round, token-for-token
+    _spec_scan (same accept keys fold_in(key, i), same draft keys
+    fold_in(key, rounds + i)); prefill-phase slots (cursor < plen)
+    spend the round's [S, C = gamma+1] forward on a C-token chunk of
+    their HISTORY row instead — under spec the history carry already
+    holds the full prompt at admission (hist_len == prompt length), so
+    it doubles as the prompt buffer and no separate chunk operand
+    exists. A completing slot samples its first token from the chunk's
+    last real column under fold_in(key, 2 * rounds + i) — a third key
+    stream that cannot collide with the accept (0..rounds-1) or draft
+    (rounds..2*rounds-1) index ranges — and emits it as ONE valid
+    entry at column 0 of its completion round; the unified
+    history-append then lands it at position hist_len exactly like an
+    accepted token, so the next round's ngram lookup already sees it.
+
+    Stateless draft sources only: a stateful source's admission reseed
+    hook (engine.draft_prefill) is a host-side call that requires the
+    drain barrier mixed dispatch deletes, so the scheduler gates those
+    to the alternating path (mixed_dispatch_ready).
+
+    Returns (toks [rounds, S, C], valid [rounds, S, C], hist,
+    hist_len, rem, cursor, cache).
+    """
+    S, H = hist.shape
+    C = gamma + 1
+    has_stop = stops >= 0
+    col = jnp.arange(C)[None, :]
+    rows = jnp.arange(S)[:, None]
+    is_pf0 = cursor < plen
+    last0 = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    # prefill-phase slots skip the last-token stop check: their history
+    # tail is prompt, not an emission (a prompt MAY end with the stop id)
+    live0 = active & (budgets > 0) \
+        & jnp.where(has_stop & ~is_pf0, last0 != stops, True)
+
+    def body(carry, i):
+        hist, hlen, cursor, cache, live, rem = carry
+        is_pf = cursor < plen
+        count = jnp.where(is_pf, jnp.clip(plen - cursor, 0, C), 0)
+        drafts, qlog, _ = draft_src.draft(
+            hist, hlen, gamma, ngram, live & ~is_pf, None,
+            jax.random.fold_in(key, rounds + i), temps, top_k, top_p)
+        last = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        pchunk = jnp.take_along_axis(
+            hist, jnp.clip(cursor[:, None] + col, 0, H - 1), axis=1)
+        toks = jnp.where(
+            is_pf[:, None], pchunk,
+            jnp.concatenate([last[:, None], drafts], axis=1))
+        W = cache.lengths
+        logits, cache = fwd(params, cfg, toks, cache, active=live,
+                            use_kernel=use_kernel)
+        emitted, n_acc = speculative_accept(
+            logits, drafts, jax.random.fold_in(key, i), temps,
+            top_k, top_p, spec_mask, qlog)
+        # decode lanes: _spec_scan's budget/stop truncation, restricted
+        # to decode phase
+        cand = (col <= n_acc[:, None]) & (col < rem[:, None]) \
+            & ~is_pf[:, None]
+        stop_at = cand & has_stop[:, None] & (emitted == stops[:, None])
+        prior = jnp.cumsum(stop_at.astype(jnp.int32), axis=1) \
+            - stop_at.astype(jnp.int32)
+        valid = cand & (prior == 0) & live[:, None]
+        # prefill lanes: completion emits the slot's FIRST token at
+        # column 0, sampled from the chunk's last real column
+        completing = is_pf & (cursor + count >= plen)
+        sidx = jnp.clip(count - 1, 0, C - 1)
+        lg = jnp.take_along_axis(logits, sidx[:, None, None],
+                                 axis=1)[:, 0, :]
+        first = sample_batched(
+            lg, jax.random.fold_in(key, 2 * rounds + i), temps, top_k,
+            top_p)
+        emitted = jnp.where(is_pf[:, None] & (col == 0),
+                            first[:, None], emitted)
+        valid = valid | ((completing & live)[:, None] & (col == 0))
+        m = valid.sum(axis=1).astype(jnp.int32)
+        # per-slot advance: a prefill step keeps its real chunk length,
+        # a decode round its accepted count — the verify's +C rolls
+        # back to exactly the written tokens either way
+        adv = jnp.where(is_pf, count, m)
+        cache = cache._replace(lengths=jnp.where(live, W + adv, W))
+        wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
+        cur = jnp.take_along_axis(hist, wpos, axis=1)
+        hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
+        hlen = jnp.where(live, hlen + m, hlen)
+        cursor = jnp.where(live & is_pf, cursor + count, cursor)
+        rem = jnp.where(live, rem - m, rem)
+        died = (valid & has_stop[:, None]
+                & (emitted == stops[:, None])).any(axis=1)
+        live = live & ~died & (rem > 0)
+        return (hist, hlen, cursor, cache, live, rem), (emitted, valid)
+
+    (hist, hist_len, cursor, cache, _, rem), (toks_blk, valid_blk) = \
+        lax.scan(body, (hist, hist_len, cursor, cache, live0, budgets),
+                 jnp.arange(rounds, dtype=jnp.int32))
+    return toks_blk, valid_blk, hist, hist_len, rem, cursor, cache
+
+
+def _mixed_spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int,
+                         ngram: int, draft_src, params, hist, hist_len,
+                         cursor, plen, cache: PagedKVCache,
+                         window: KVWindow, win_len, active, temps,
+                         stops, budgets, top_k: int, top_p: float, key,
+                         spec_mask, use_kernel: bool = False):
+    """Write-combined twin of _mixed_spec_scan — lane semantics are
+    IDENTICAL; each round's [S, C] forward stages into the window and
+    win_len advances by the per-slot real count (chunk length for a
+    prefill lane, accepted count for a decode lane): filler, rejected
+    drafts, and dead-round repeats sit past win_len, unattendable and
+    never flushed (_spec_scan_win's rollback-by-construction, extended
+    to chunk raggedness).
+
+    Returns (toks [rounds, S, C], valid [rounds, S, C], hist,
+    hist_len, rem, cursor, cache, window, win_len).
+    """
+    S, H = hist.shape
+    C = gamma + 1
+    has_stop = stops >= 0
+    col = jnp.arange(C)[None, :]
+    rows = jnp.arange(S)[:, None]
+    is_pf0 = cursor < plen
+    last0 = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    live0 = active & (budgets > 0) \
+        & jnp.where(has_stop & ~is_pf0, last0 != stops, True)
+
+    def body(carry, i):
+        hist, hlen, cursor, win, wlen, live, rem = carry
+        is_pf = cursor < plen
+        count = jnp.where(is_pf, jnp.clip(plen - cursor, 0, C), 0)
+        drafts, qlog, _ = draft_src.draft(
+            hist, hlen, gamma, ngram, live & ~is_pf, None,
+            jax.random.fold_in(key, rounds + i), temps, top_k, top_p)
+        last = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        pchunk = jnp.take_along_axis(
+            hist, jnp.clip(cursor[:, None] + col, 0, H - 1), axis=1)
+        toks = jnp.where(
+            is_pf[:, None], pchunk,
+            jnp.concatenate([last[:, None], drafts], axis=1))
+        logits, win = paged_forward_window(params, cfg, toks, cache,
+                                           win, wlen, active=live,
+                                           use_kernel=use_kernel)
+        emitted, n_acc = speculative_accept(
+            logits, drafts, jax.random.fold_in(key, i), temps,
+            top_k, top_p, spec_mask, qlog)
+        cand = (col <= n_acc[:, None]) & (col < rem[:, None]) \
+            & ~is_pf[:, None]
+        stop_at = cand & has_stop[:, None] & (emitted == stops[:, None])
+        prior = jnp.cumsum(stop_at.astype(jnp.int32), axis=1) \
+            - stop_at.astype(jnp.int32)
+        valid = cand & (prior == 0) & live[:, None]
+        completing = is_pf & (cursor + count >= plen)
+        sidx = jnp.clip(count - 1, 0, C - 1)
+        lg = jnp.take_along_axis(logits, sidx[:, None, None],
+                                 axis=1)[:, 0, :]
+        first = sample_batched(
+            lg, jax.random.fold_in(key, 2 * rounds + i), temps, top_k,
+            top_p)
+        emitted = jnp.where(is_pf[:, None] & (col == 0),
+                            first[:, None], emitted)
+        valid = valid | ((completing & live)[:, None] & (col == 0))
+        m = valid.sum(axis=1).astype(jnp.int32)
+        adv = jnp.where(is_pf, count, m)
+        wlen = jnp.where(live, wlen + adv, wlen)
+        wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
+        cur = jnp.take_along_axis(hist, wpos, axis=1)
+        hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
+        hlen = jnp.where(live, hlen + m, hlen)
+        cursor = jnp.where(live & is_pf, cursor + count, cursor)
+        rem = jnp.where(live, rem - m, rem)
+        died = (valid & has_stop[:, None]
+                & (emitted == stops[:, None])).any(axis=1)
+        live = live & ~died & (rem > 0)
+        return (hist, hlen, cursor, win, wlen, live, rem), \
+            (emitted, valid)
+
+    (hist, hist_len, cursor, window, win_len, _, rem), \
+        (toks_blk, valid_blk) = lax.scan(
+            body, (hist, hist_len, cursor, window, win_len, live0,
+                   budgets),
+            jnp.arange(rounds, dtype=jnp.int32))
+    return (toks_blk, valid_blk, hist, hist_len, rem, cursor, cache,
+            window, win_len)

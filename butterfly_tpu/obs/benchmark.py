@@ -124,6 +124,7 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                           inflight_blocks: int = 2,
                           kv_write_combine: bool = True,
                           prefill_flash_warm: bool = True,
+                          mixed_dispatch: bool = True,
                           isolated_decode_tok_s_chip: Optional[float] = None,
                           seed: int = 0) -> Dict:
     """Benchmark the PRODUCT serving path: Scheduler + ServingEngine with
@@ -154,7 +155,8 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                        decode_steps_per_tick=decode_steps_per_tick,
                        inflight_blocks=inflight_blocks,
                        kv_write_combine=kv_write_combine,
-                       prefill_flash_warm=prefill_flash_warm)
+                       prefill_flash_warm=prefill_flash_warm,
+                       mixed_dispatch=mixed_dispatch)
     if prefill_max_batch is not None:
         rt = rt.replace(prefill_max_batch=prefill_max_batch)
     engine = ServingEngine(model, params, rt)
@@ -217,28 +219,36 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
                 f"(ids {unfinished[:8]}): throughput would be bogus")
     capacity = float(np.median(caps))
 
-    # Phase 2 — staggered arrivals at utilization * measured capacity
+    # Phase 2 — staggered arrivals at utilization * measured capacity.
+    # One pre-generated prompt list drives BOTH legs (the fused run
+    # below and the alternating `_alt` reference at the end) so the
+    # pair differs only in dispatch strategy, not workload.
     interarrival = max_new / (utilization * capacity)
+    p2_prompts = [prompt() for _ in range(n_requests)]
+
+    def _drive_staggered(sched_):
+        reqs_ = []
+        t0 = time.monotonic()
+        nxt = t0
+        j = 0
+        while j < n_requests or sched_.has_work:
+            while j < n_requests and time.monotonic() >= nxt:
+                reqs_.append(sched_.submit(p2_prompts[j],
+                                           max_new_tokens=max_new))
+                nxt += interarrival
+                j += 1
+            if sched_.has_work:
+                sched_.tick()
+            elif j < n_requests:
+                time.sleep(min(0.002, max(0.0, nxt - time.monotonic())))
+        return reqs_, time.monotonic() - t0
 
     from butterfly_tpu.obs.timeseries import SignalRecorder, series_summary
     # fast cadence: bench phases last seconds, not minutes, so the serve
     # default of 1s would catch ~3 samples — too few for a slope
     rec = SignalRecorder(interval_s=0.05, capacity=4096)
     sched = Scheduler(engine, timeseries=rec)
-    reqs = []
-    t_start = time.monotonic()
-    next_arrival = t_start
-    i = 0
-    while i < n_requests or sched.has_work:
-        while i < n_requests and time.monotonic() >= next_arrival:
-            reqs.append(sched.submit(prompt(), max_new_tokens=max_new))
-            next_arrival += interarrival
-            i += 1
-        if sched.has_work:
-            sched.tick()
-        elif i < n_requests:
-            time.sleep(min(0.002, max(0.0, next_arrival - time.monotonic())))
-    wall = time.monotonic() - t_start
+    reqs, wall = _drive_staggered(sched)
 
     m = sched.metrics()
     unfinished = [r.id for r in reqs if r.state != "finished"]
@@ -260,8 +270,18 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
         "serving_offered_utilization": utilization,
         "serving_kv_quant": kv_quant,
         "serving_kv_write_combine": kv_write_combine,
+        "serving_mixed_dispatch": mixed_dispatch,
         "serving_preemptions": m["preemptions_total"],
     }
+    # unified mixed dispatch (ISSUE 18): the admission barrier count —
+    # ~0 under the fused path, one per mid-flight arrival under the
+    # alternating reference — and the prompt tokens that rode fused
+    # blocks instead of dedicated prefill dispatches
+    out["serving_admission_barriers"] = \
+        sched.barrier_causes().get("admission", 0.0)
+    if "mixed_dispatch_prefill_tokens_inline" in m:
+        out["mixed_dispatch_prefill_tokens_inline"] = \
+            m["mixed_dispatch_prefill_tokens_inline"]
     # write-combined window flush cost + volume (kv_write_combine;
     # absent window-off): kv_flush_seconds percentiles say what the
     # one-scatter-per-drain flush dispatch costs the host, the token
@@ -290,6 +310,7 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
               "tick_phase_admit_p50", "tick_phase_admit_p95",
               "tick_phase_assemble_p50", "tick_phase_assemble_p95",
               "tick_phase_dispatch_p50", "tick_phase_dispatch_p95",
+              "tick_phase_mixed_p50", "tick_phase_mixed_p95",
               "tick_host_frac", "tick_device_frac"):
         if k in m:
             out[k] = m[k]
@@ -316,6 +337,32 @@ def run_serving_benchmark(model, params, *, n_requests: int = 64,
     # over the phase-2 window: how throughput and page headroom MOVED,
     # not just their endpoint averages
     out["serving_series_summary"] = series_summary(rec.dump())
+    # Alternating-path reference (`_alt` suffix — the `_nowin`/`_dense`
+    # pattern): the SAME phase-2 prompts and offered rate with
+    # mixed_dispatch off, i.e. dedicated prefill dispatches plus the
+    # admission drain barrier per mid-flight arrival. The pair on one
+    # JSON line is the ISSUE-18 evidence: barriers retired (≈0 vs N)
+    # and what that buys the ITL tail under prompt load.
+    if mixed_dispatch:
+        alt_engine = ServingEngine(model, params,
+                                   rt.replace(mixed_dispatch=False))
+        warm_alt = Scheduler(alt_engine)
+        for w in widths:
+            for _ in range(w):
+                warm_alt.submit(prompt(), max_new_tokens=4)
+            warm_alt.run_until_done()
+        alt = Scheduler(alt_engine)
+        alt_reqs, alt_wall = _drive_staggered(alt)
+        am = alt.metrics()
+        if not [r for r in alt_reqs if r.state != "finished"]:
+            out["serving_tokens_per_sec_per_chip_alt"] = \
+                am["tokens_generated_total"] / alt_wall
+            for k in ("ttft_p50", "ttft_p95",
+                      "itl_req_mean_p50", "itl_req_mean_p95"):
+                if k in am:
+                    out[k + "_alt"] = am[k]
+            out["serving_admission_barriers_alt"] = \
+                alt.barrier_causes().get("admission", 0.0)
     return out
 
 
@@ -555,6 +602,7 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
                         deadline_ms: Optional[float] = 30000.0,
                         arrival: Optional[str] = None,
                         host_kv_tier_mb: float = 0.0,
+                        mixed_dispatch: bool = True,
                         seed: int = 0,
                         max_seconds: float = 900.0) -> Dict:
     """Mixed-workload serving phase (ISSUE 10): the canned
@@ -617,7 +665,8 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
                             inflight_blocks=inflight_blocks,
                             prefix_caching=True,
                             host_kv_tier_mb=host_kv_tier_mb,
-                            prefill_flash_warm=prefill_flash_warm)
+                            prefill_flash_warm=prefill_flash_warm,
+                            mixed_dispatch=mixed_dispatch)
     if prefill_max_batch is not None:
         base_rt = base_rt.replace(prefill_max_batch=prefill_max_batch)
     engine = ServingEngine(model, params, base_rt)
@@ -682,11 +731,21 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
               "tick_phase_admit_p50", "tick_phase_admit_p95",
               "tick_phase_assemble_p50", "tick_phase_assemble_p95",
               "tick_phase_dispatch_p50", "tick_phase_dispatch_p95",
+              "tick_phase_mixed_p50", "tick_phase_mixed_p95",
               "tick_host_frac", "tick_device_frac"):
         if k in mm:
             out["mixed_" + k] = r(mm[k])
     out["mixed_drain_barriers_by_cause"] = {
         c: v for c, v in sched.barrier_causes().items() if v}
+    # unified mixed dispatch (ISSUE 18) under the CONTESTED workload:
+    # admission barriers ≈ 0 while every prompt token rides the fused
+    # blocks (the heavy-prompt regime where the alternating path's
+    # admission stalls actually cost ITL tail)
+    out["mixed_admission_barriers"] = \
+        sched.barrier_causes().get("admission", 0.0)
+    if "mixed_dispatch_prefill_tokens_inline" in mm:
+        out["mixed_dispatch_prefill_tokens_inline"] = \
+            r(mm["mixed_dispatch_prefill_tokens_inline"])
     # host KV tier (ISSUE 17): under the deliberately starved pool,
     # evictions demote to host RAM and prefix hits revive — the tier's
     # hit-rate / restore-latency economics under real contention
@@ -702,6 +761,29 @@ def run_mixed_benchmark(model, params, *, n_requests: int = 32,
     # and pages-free series here are the ones that actually move (the
     # acceptance evidence that the time-series ring sees contention)
     out["mixed_series_summary"] = series_summary(rec.dump())
+    # Alternating-path reference (`_alt` suffix): the SAME trace and
+    # operating point with mixed_dispatch off. Under this phase's
+    # bursty heavy-prompt arrivals the alternating path pays one
+    # admission drain barrier per mid-flight arrival — the
+    # fused-vs-alternating ITL/TTFT pair is the ISSUE-18 acceptance
+    # evidence at the load where it matters.
+    if mixed_dispatch:
+        alt_engine = ServingEngine(model, params,
+                                   base_rt.replace(mixed_dispatch=False))
+        warm_a = Scheduler(alt_engine)
+        for s in specs:
+            if len(s.tokens) + 1 <= alt_engine.cache.max_seq:
+                warm_a.submit(s.tokens, max_new_tokens=2)
+        warm_a.run_until_done(max_ticks=10 ** 6)
+        alt = Scheduler(alt_engine, slo_ttft_s=slo_ttft_s)
+        res_a = drive_open_loop(alt, specs, max_seconds=max_seconds)
+        out["mixed_serving_tokens_per_sec_alt"] = r(res_a["tokens_per_sec"])
+        for k in ("ttft_p50", "ttft_p95",
+                  "itl_req_mean_p50", "itl_req_mean_p95"):
+            if k in res_a:
+                out["mixed_" + k + "_alt"] = r(res_a[k])
+        out["mixed_admission_barriers_alt"] = \
+            alt.barrier_causes().get("admission", 0.0)
     out["operating_points"] = sw["points"]
     out["operating_point_knee"] = (
         {k: r(v) for k, v in sw["knee"].items()} if sw["knee"] else None)

@@ -41,7 +41,8 @@ from typing import Any, Dict, List, Optional
 #: appends), kept explicit so per-tick phase sums reconcile with tick
 #: wall time instead of silently under-counting.
 TICK_PHASES = ("expire", "drain_oldest", "drain_barrier", "admit",
-               "assemble", "dispatch", "spec_emit", "flush", "other")
+               "assemble", "dispatch", "mixed", "spec_emit", "flush",
+               "other")
 
 #: Closed label set for drain_barriers_total{cause=...} — the
 #: membership-change classes that force a FULL drain barrier.

@@ -246,6 +246,14 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     batch/slots over `data`, kv heads over `tensor`
     (parallel/partition.py warm_prefix_specs, matching the pool
     sharding paged_cache_specs assigns).
+
+    Mixed-dispatch note (ISSUE 18): the fused mixed block does NOT call
+    this prefill entry point — inside the scan every lane (decode OR
+    prefill chunk) attends through the per-step paged/window attention
+    of the decode program, with per-slot lengths/cursors doing the
+    masking. This kernel remains the ALTERNATING path's chunked-prefill
+    engine (`mixed_dispatch=False`, or a stateful draft source's
+    automatic fallback).
     """
     from jax.sharding import PartitionSpec as P
 

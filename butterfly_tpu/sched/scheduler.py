@@ -187,6 +187,40 @@ class Scheduler:
                                              num_slots=engine.num_slots)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
+        # Mixed dispatch (ISSUE 18): prefill chunks and decode/spec
+        # tokens ride ONE fused block per tick (engine._mixed_scan and
+        # twins) — admission becomes a host-side carry edit between
+        # dispatches (_seed_mixed_slot) instead of a drain barrier +
+        # separate prefill dispatch, retiring the admission barrier
+        # cause as a class. Continuous scheduler only; stateful draft
+        # sources fall back to the alternating path (their admission
+        # reseed hook needs the barrier this mode deletes).
+        self._mixed_mode = (rt.scheduler == "continuous"
+                            and engine.mixed_dispatch_ready)
+        # per-step chunk width C: under spec the verify shape pins it
+        # to gamma+1; otherwise the inline budget (clamped by the tick
+        # chunk budget) IS the width — one prefilling slot chews C
+        # tokens per scan step
+        self._mixed_chunk = (rt.speculative_gamma + 1) if rt.speculative_gamma > 0 \
+            else max(1, min(rt.prefill_inline_budget, rt.prefill_chunk))
+        # concurrent-prefill cap — THE ITL-tail knob: at most this many
+        # slots may be in prefill phase at once, so a scan step never
+        # chews more than ~prefill_inline_budget prompt tokens while
+        # decode slots wait on it
+        self._mixed_max_pf = max(1, rt.prefill_inline_budget // self._mixed_chunk)
+        # mixed-dispatch device carries: the per-slot chunk cursor
+        # (DONATED to every mixed block, rebound from its result —
+        # BTF002 contract) and, non-spec, the prompt-buffer rows the
+        # prefill lanes read (under spec the token-history carry
+        # doubles as the buffer). _plen_host is the per-slot prompt
+        # length operand (host-owned; 0 marks a slot decode-phase).
+        self._cursor_dev = None
+        self._pbuf_dev = None
+        self._plen_host = np.zeros((engine.num_slots,), np.int32)
+        # prompt tokens advanced INSIDE fused mixed blocks (the work
+        # the retired admission barrier used to serialize) — the bench
+        # key mixed_dispatch_prefill_tokens_inline
+        self._inline_pf_tokens = 0
         # The prefill GROUP: requests admitted to slots whose prompts are
         # not yet fully in the KV cache. Each tick their next chunks are
         # packed under the prefill_chunk token budget and dispatched as
@@ -204,7 +238,15 @@ class Scheduler:
         #   ("decode", final [S] carry, block [k, S], k, snapshot, t)
         #   ("spec",   hist_len [S],   (toks [R, S, C], valid
         #              [R, S, C]), R rounds, snapshot, t)
-        # where snapshot maps slot -> (request, generation). Each tick
+        #   ("mixed",  final [S], (block [k, S], valid [k, S]), k,
+        #              snapshot, t, pf_done slots, emit_vec [S])
+        #   ("mixed_spec", hist_len [S], (toks, valid) [R, S, C], R,
+        #              snapshot, t, pf_done slots, None)
+        # where snapshot maps slot -> (request, generation); mixed
+        # entries additionally carry the slots whose prefill completed
+        # inside the block (drain-time state transitions) and, plain
+        # mixed, the host-simulated per-slot emission counts the next
+        # dispatch's budget look-ahead subtracts. Each tick
         # dispatches ONE jitted scan (engine.decode_block_async or
         # engine.spec_block_async) chained on the previous block's
         # device-resident carry, and up to
@@ -669,6 +711,7 @@ class Scheduler:
         # and dropping resets the staged count so a later flush can
         # never scatter stale entries into reclaimed pages
         self.engine.drop_kv_window()
+        self._plen_host[:] = 0  # mixed carries: every slot decode-phase
         self._epoch += 1  # cached decode operands are now stale
         for req in self.unfinished_requests():
             req.state = "cancelled"
@@ -765,14 +808,22 @@ class Scheduler:
         while len(self._inflight) >= depth:
             if self._drain_oldest():
                 self._drain_inflight("finish")
-        # admission barrier — only when admission can actually make
-        # progress, so a standing queue behind full slots doesn't
-        # serialize the pipeline
-        if self._prefill_group or (self.waiting
-                                   and self._free_slot() is not None):
+        mixed = self._mixed_mode
+        # admission barrier — retired as a class under mixed dispatch,
+        # where admission is a host-side carry edit between dispatches
+        # (_admit_inline) and the prompt rides the next fused block.
+        # The alternating path still barriers whenever admission can
+        # actually make progress, so a standing queue behind full
+        # slots doesn't serialize the pipeline.
+        if not mixed and (self._prefill_group
+                          or (self.waiting
+                              and self._free_slot() is not None)):
             self._drain_inflight("admission")
         t_admit = time.monotonic()
-        self._admit()
+        if mixed:
+            self._admit_inline()
+        else:
+            self._admit()
         self._phase_add("admit", time.monotonic() - t_admit)
         if self.running:
             self._h_batch.observe(len(self.running))
@@ -795,11 +846,30 @@ class Scheduler:
                 need = min(len(req.all_tokens) + horizon,
                            len(req.prompt) + req.max_new_tokens)
                 self._ensure_or_preempt(req, need)
+        if mixed and self._prefill_group:
+            # prefill lanes advance up to C tokens per scan step, so
+            # their device write horizon is k*C per undrained block
+            pf_h = (len(self._inflight) + 1) * k * self._mixed_chunk + 1
+            for req in list(self._prefill_group):
+                if req in self._prefill_group:
+                    need = min(len(req.all_tokens) + pf_h,
+                               len(req.prompt) + req.max_new_tokens)
+                    self._ensure_or_preempt(req, need)
         t_disp = time.monotonic()
         a0 = tp["assemble"]
-        dispatched = self._spec_block(k) if spec else self._decode_block(k)
-        self._phase_add("dispatch", max(0.0, time.monotonic() - t_disp
-                                        - (tp["assemble"] - a0)))
+        if mixed:
+            # the fused block covers both phases: its dispatch section
+            # gets its own phase label so tick anatomy stays honest
+            # about where admission+prefill time went
+            dispatched = self._mixed_block(k)
+            self._phase_add("mixed", max(0.0, time.monotonic() - t_disp
+                                         - (tp["assemble"] - a0)))
+        else:
+            dispatched = self._spec_block(k) if spec \
+                else self._decode_block(k)
+            self._phase_add("dispatch",
+                            max(0.0, time.monotonic() - t_disp
+                                - (tp["assemble"] - a0)))
         if not dispatched and (self._inflight or self._pending_first):
             # nothing dispatchable (every budget is spent on device):
             # the remaining tokens exist only in flight — fetch them
@@ -1012,7 +1082,7 @@ class Scheduler:
         # device-bound one a fat fetch share)
         pp = self.ticklog.phase_percentiles()
         for name in ("drain", "admit", "assemble", "dispatch",
-                     "expire", "spec_emit", "flush"):
+                     "mixed", "expire", "spec_emit", "flush"):
             if name in pp:
                 m[f"tick_phase_{name}_p50"] = pp[name]["p50"]
                 m[f"tick_phase_{name}_p95"] = pp[name]["p95"]
@@ -1023,6 +1093,13 @@ class Scheduler:
         if total > 0:
             m["tick_host_frac"] = self._t_host_total / total
             m["tick_device_frac"] = self._t_device_total / total
+        if self._mixed_mode:
+            # prompt tokens that rode fused mixed blocks (ISSUE 18) —
+            # under mixed dispatch ALL prefill work is inline, so this
+            # pairs with drain_barriers admission == 0 as the evidence
+            # that the admission barrier class is retired
+            m["mixed_dispatch_prefill_tokens_inline"] = \
+                float(self._inline_pf_tokens)
         return m
 
     def barrier_causes(self) -> Dict[str, float]:
@@ -1117,6 +1194,101 @@ class Scheduler:
                 budget -= used
                 if budget <= 0:
                     return
+
+    def _admit_inline(self) -> None:
+        """Mixed-dispatch admission (ISSUE 18): pull waiting requests
+        into free slots WITHOUT a drain barrier or a separate prefill
+        dispatch — the prompt rides the next fused block's prefill
+        lanes. Admission here is pure host bookkeeping plus per-slot
+        device carry edits between dispatches (_seed_mixed_slot, the
+        established reset_slot pattern: ``.at[slot].set`` on arrays
+        in-flight blocks never touch for a free slot).
+
+        The concurrent-prefill cap (_mixed_max_pf, derived from
+        RuntimeConfig.prefill_inline_budget) bounds how many slots may
+        be in prefill phase at once — with chunk width C per slot per
+        scan step, at most ~prefill_inline_budget prompt tokens are
+        chewed per step while decode slots wait on that step's
+        forward. That bound IS the ITL-tail knob."""
+        admitted = False
+        while (self.waiting
+               and len(self._prefill_group) < self._mixed_max_pf):
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            if self._shares_inflight_prefix(req):
+                break  # defer: a gang member is writing req's prefix
+            cached = self.alloc.admit(slot, req.all_tokens,
+                                      len(req.all_tokens) + 1)
+            if cached is None:
+                break  # pool exhausted; decode will free/preempt
+            self.waiting.popleft()
+            req.slot, req.state = slot, "prefilling"
+            req.prefilled = req.cached_at_admit = cached
+            self.slots[slot] = req
+            self._prefill_group.append(req)
+            self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+            self._seed_mixed_slot(req)
+            admitted = True
+            wait = time.monotonic() - req.t_enqueued
+            self._h_queue_wait.observe(wait)
+            if self.flightrec is not None:
+                self.flightrec.note("admit", id=req.id, slot=slot,
+                                    queue_wait_s=wait, cached=cached)
+            if self.trace is not None:
+                self.trace.event(req.id, "admit", slot=slot,
+                                 queue_wait_s=wait,
+                                 prefix_cache_hit_tokens=cached,
+                                 resumed=req.preemptions > 0)
+        if admitted:
+            self._epoch += 1  # membership changed: operands rebuild
+
+    def _seed_mixed_slot(self, req: Request) -> None:
+        """Device-carry seeding for one mixed-dispatch admission. Every
+        write is an ``.at[slot].set`` on the CURRENT carry binding —
+        i.e. on the result of the newest in-flight block — so it lands
+        after that block in device program order. The slot is free in
+        every in-flight block's snapshot (inactive lanes advance
+        nothing and their writes land on the null page), so nothing
+        here races a dispatched program.
+
+        Seeds: pool lengths at the cached prefix (the warm-prefix
+        contract), window count at zero, the chunk cursor at the
+        cached prefix, and the prompt tokens — into the prompt-buffer
+        row (plain mixed) or the token-history row (spec mixed, where
+        history doubles as the prompt buffer and the budget injects
+        into the device remainder carry when one is live)."""
+        eng = self.engine
+        slot, toks = req.slot, req.all_tokens
+        cached = req.cached_at_admit
+        with eng._mesh_ctx():
+            eng.cache = eng.cache._replace(
+                lengths=eng.cache.lengths.at[slot].set(cached))
+            if eng._win_len is not None:
+                eng._win_len = eng._win_len.at[slot].set(0)
+            cur = self._cursor_dev if self._cursor_dev is not None \
+                else jnp.zeros((eng.num_slots,), jnp.int32)
+            self._cursor_dev = cur.at[slot].set(cached)
+            self._plen_host[slot] = len(toks)
+            if self._spec_mode:
+                row = np.zeros((self._hist_dev.shape[1],), np.int32)
+                row[:len(toks)] = toks
+                self._hist_dev = self._hist_dev.at[slot].set(
+                    jnp.asarray(row))
+                self._hist_len_dev = self._hist_len_dev.at[slot].set(
+                    len(toks))
+                if self._spec_rem is not None:
+                    self._spec_rem = self._spec_rem.at[slot].set(
+                        req.max_new_tokens - len(req.output))
+            else:
+                if self._pbuf_dev is None:
+                    self._pbuf_dev = jnp.zeros(
+                        (eng.num_slots, eng.cache.max_seq), jnp.int32)
+                row = np.zeros((self._pbuf_dev.shape[1],), np.int32)
+                row[:len(toks)] = toks
+                self._pbuf_dev = self._pbuf_dev.at[slot].set(
+                    jnp.asarray(row))
 
     def _admit_round(self, budget: Optional[int]) -> Optional[int]:
         """One gang-admission round: pull waiting requests into the
@@ -1368,7 +1540,13 @@ class Scheduler:
         """Per-block host operands — the active/temps/stops/base-budget
         /spec-mask arrays and the slot snapshot — cached on the batch-
         membership epoch: back-to-back blocks over an unchanged batch
-        skip the per-slot Python rebuild and the np.asarray churn."""
+        skip the per-slot Python rebuild and the np.asarray churn.
+
+        Mixed dispatch extends the batch to prefill-group members too:
+        their lanes ride the same block (phase decided on device by
+        cursor < plen), and their budget is the full remaining
+        emission allowance (output is empty unless resumed from a
+        preemption)."""
         if self._operands_epoch != self._epoch:
             t0 = time.monotonic()
             S = self.engine.num_slots
@@ -1377,7 +1555,8 @@ class Scheduler:
             stops = np.full((S,), -1, np.int32)
             base = np.zeros((S,), np.int32)
             specm = np.zeros((S,), bool)
-            for req in self.running:
+            batch = self._all_live if self._mixed_mode else self.running
+            for req in batch:
                 active[req.slot] = True
                 temps[req.slot] = req.temperature
                 stops[req.slot] = req.stop_token
@@ -1393,7 +1572,7 @@ class Scheduler:
                                   - int(pending))
             self._operands = (active, temps, stops, base, specm,
                               {req.slot: (req, req.preemptions)
-                               for req in self.running})
+                               for req in batch})
             self._operands_epoch = self._epoch
             self._phase_add("assemble", time.monotonic() - t0)
         return self._operands
@@ -1451,6 +1630,115 @@ class Scheduler:
         self._hist_dev, self._hist_len_dev, self._spec_rem = hist, hlen, rem
         self._inflight.append(("spec", hlen, (toks, valid), rounds,
                                snapshot, time.monotonic()))
+        self._note_bubble()
+        return True
+
+    def _mixed_block(self, k: int) -> bool:
+        """Dispatch ONE fused MIXED block (ISSUE 18): decode (or spec)
+        lanes and prefill lanes ride the same k-step jitted program
+        (engine.mixed_block_async / mixed_spec_block_async), chained
+        on the device carries exactly like _decode_block/_spec_block —
+        one dispatch per tick covering both phases.
+
+        The host runs a cheap lockstep simulation of each prefill
+        lane's cursor: chunk progress is deterministic while a lane is
+        live (a prefilling lane cannot die mid-prompt — its first
+        possible emission is the completion-sampled first token), so
+        ``req.prefilled`` advances to the block's post-state at
+        DISPATCH time and the completion set rides the in-flight entry
+        for drain-time state transitions (_mixed_transitions). For
+        plain mixed the same simulation also yields per-slot emission
+        counts, the budget look-ahead chained dispatches subtract
+        (stop-deaths make it an over-estimate, which is safe for the
+        same frozen-chain-token reason as _decode_block). Spec mixed
+        instead threads the device-resident remainder carry through,
+        exactly like _spec_block.
+
+        Returns True iff a block was dispatched."""
+        if not (self.running or self._prefill_group):
+            return False
+        active, temps, stops, base, specm, snapshot = self._assemble()
+        S = self.engine.num_slots
+        self._key, sub = jax.random.split(self._key)
+        plen = self._plen_host
+        cursor = self._cursor_dev if self._cursor_dev is not None \
+            else jnp.zeros((S,), jnp.int32)
+        if self._spec_mode:
+            C = self._mixed_chunk  # gamma + 1: the verify shape
+            if self._spec_rem is None:
+                if not (active & (base > 0)).any():
+                    return False  # everything already emitted (undrained)
+                budgets = base
+            else:
+                budgets = self._spec_rem
+            # deterministic cursor advance: C prompt tokens per round
+            # while mid-prefill (emissions can't kill the lane first)
+            pf_done = []
+            for req in list(self._prefill_group):
+                p = int(plen[req.slot])
+                if req.prefilled < p:
+                    adv = min(p, req.prefilled + k * C)
+                    self._inline_pf_tokens += adv - req.prefilled
+                    req.prefilled = adv
+                if req.prefilled >= p:
+                    pf_done.append(req.slot)
+            toks, valid, hist, hlen, rem, cursor = \
+                self.engine.mixed_spec_block_async(
+                    self._hist_dev, self._hist_len_dev, cursor, plen,
+                    active, temps, stops, budgets, specm, sub, k)
+            self._hist_dev, self._hist_len_dev = hist, hlen
+            self._spec_rem, self._cursor_dev = rem, cursor
+            self._inflight.append(("mixed_spec", hlen, (toks, valid), k,
+                                   snapshot, time.monotonic(), pf_done,
+                                   None))
+            self._note_bubble()
+            return True
+        # plain mixed: chunk width C only while a prompt is actually in
+        # flight — with no prefill lane the program collapses to C=1,
+        # the exact _decode_scan shape (and its RNG stream)
+        C = self._mixed_chunk if self._prefill_group else 1
+        ahead = np.zeros((S,), np.int64)
+        for ent in self._inflight:
+            ahead = ahead + ent[7]  # per-slot emission estimates
+        budgets = np.maximum(base - ahead, 0).astype(np.int32)
+        if not (active & (budgets > 0)).any():
+            return False  # every lane is out of budget on device
+        # lockstep host sim per lane: cursor end-state, emission count,
+        # completion membership. Mirrors the device scan exactly up to
+        # stop-deaths, which only shrink emissions after the fact.
+        emit_vec = np.zeros((S,), np.int32)
+        pf_done = []
+        for slot, (req, _gen) in snapshot.items():
+            b = int(budgets[slot])
+            if not active[slot] or b <= 0:
+                continue
+            c, p, e = req.prefilled, int(plen[slot]), 0
+            for _ in range(k):
+                if c < p:
+                    c = min(p, c + C)
+                    if c < p:
+                        continue
+                e += 1  # completion first token, or a decode step
+                if e >= b:
+                    break
+            if c != req.prefilled:
+                self._inline_pf_tokens += c - req.prefilled
+                req.prefilled = c
+            emit_vec[slot] = e
+            if req.state == "prefilling" and c >= p:
+                pf_done.append(slot)
+        cur = self._next_dev if self._next_dev is not None \
+            else self._next_tokens
+        if self._pbuf_dev is None:
+            self._pbuf_dev = jnp.zeros((S, self.engine.cache.max_seq),
+                                       jnp.int32)
+        block, valid, final, cursor = self.engine.mixed_block_async(
+            cur, cursor, self._pbuf_dev, plen, active, temps, stops,
+            budgets, sub, k, C)
+        self._next_dev, self._cursor_dev = final, cursor
+        self._inflight.append(("mixed", final, (block, valid), k,
+                               snapshot, time.monotonic(), pf_done,
+                               emit_vec))
         self._note_bubble()
         return True
 
@@ -1542,8 +1830,8 @@ class Scheduler:
         for ent in blocks:
             if ent[0] == "decode":
                 parts.append(ent[2].reshape(-1))
-            else:  # spec: stacked emissions + validity mask ride the
-                # same single fetch (bool widened to the int dtype)
+            else:  # spec/mixed: stacked emissions + validity mask ride
+                # the same single fetch (bool widened to the int dtype)
                 toks3, valid3 = ent[2]
                 parts.append(toks3.reshape(-1))
                 parts.append(valid3.astype(jnp.int32).reshape(-1))
@@ -1570,9 +1858,14 @@ class Scheduler:
             self._emit(req, int(tok))
         off = nf
         for ent in blocks:
-            kind, _, _, k, snapshot, t_dispatch = ent
+            kind, _, _, k, snapshot, t_dispatch = ent[:6]
             self._h_decode_block.observe(now - t_dispatch)
-            if kind == "spec":
+            if kind in ("mixed", "mixed_spec"):
+                # prefill lanes that completed inside this block leave
+                # the prefill group BEFORE their first token (riding
+                # the block's emission arrays) is emitted below
+                self._mixed_transitions(ent[6], snapshot)
+            if kind in ("spec", "mixed_spec"):
                 toks3 = vals[off:off + k * S * C].reshape(k, S, C)
                 off += k * S * C
                 valid3 = vals[off:off + k * S * C].reshape(k, S, C) != 0
@@ -1580,6 +1873,27 @@ class Scheduler:
                 t_se = time.monotonic()
                 self._emit_spec(toks3, valid3, snapshot)
                 self._phase_add("spec_emit", time.monotonic() - t_se)
+                continue
+            if kind == "mixed":
+                # [k, S] tokens + validity: a lane emits at most one
+                # token per step, valid only on decode steps and the
+                # completion step's first token
+                rows = vals[off:off + k * S].reshape(k, S)
+                off += k * S
+                ok = vals[off:off + k * S].reshape(k, S) != 0
+                off += k * S
+                for slot, (req, gen) in snapshot.items():
+                    if req.done or req.slot != slot \
+                            or req.preemptions != gen:
+                        continue
+                    for tok, good in zip(rows[:, slot].tolist(),
+                                         ok[:, slot].tolist()):
+                        if not good:
+                            continue
+                        self._next_tokens[slot] = tok
+                        self._emit(req, tok)
+                        if req.done:
+                            break
                 continue
             rows = vals[off:off + k * S].reshape(k, S)
             off += k * S
@@ -1597,6 +1911,35 @@ class Scheduler:
                         break
         self._epoch += 1  # outputs / pending-first changed
         return self._c_finished.value > finished_before
+
+    def _mixed_transitions(self, pf_slots, snapshot: Dict) -> None:
+        """Drain-time completion transitions for a mixed block's
+        prefill lanes: members whose prompt finished inside the block
+        (the dispatch-time host simulation recorded the set) leave the
+        prefill group and start decoding. Pages publish for prefix
+        reuse exactly where the alternating path's _finish_prefill did
+        it — after a point where every staged K/V byte is flushed
+        (this drain flushed the window first). The generation check
+        skips members cancelled or preempted since dispatch."""
+        for slot in pf_slots:
+            entry = snapshot.get(slot)
+            if entry is None:
+                continue
+            req, gen = entry
+            if req.done or req.slot != slot or req.preemptions != gen:
+                continue
+            if req.state != "prefilling":
+                continue  # an earlier drained block already transitioned
+            self.alloc.register(slot, req.all_tokens)
+            self._prefill_group.remove(req)
+            req.state = "running"
+            self.running.append(req)
+            ran = len(req.all_tokens) - req.cached_at_admit
+            self._h_prefill_tokens.observe(ran)
+            if self.trace is not None:
+                self.trace.event(req.id, "prefill_done", tokens=ran,
+                                 total=len(req.all_tokens))
+            self._epoch += 1
 
     def _emit_spec(self, toks3: np.ndarray, valid3: np.ndarray,
                    snapshot: Dict) -> None:
@@ -1618,6 +1961,12 @@ class Scheduler:
             t_rows = toks3[:, slot, :].tolist()
             v_rows = valid3[:, slot, :].tolist()
             for r in range(R):
+                # mixed dispatch: a round that emits the request's very
+                # first token is the prefill-completion round, not a
+                # verify round — it must not count as a zero-acceptance
+                # observation (the alternating path's first token never
+                # passes through here either)
+                first_round = req.t_first_token is None
                 cnt = 0
                 for tok, ok in zip(t_rows[r], v_rows[r]):
                     if not ok:
@@ -1627,7 +1976,7 @@ class Scheduler:
                     self._emit(req, tok)
                     if req.done:
                         break
-                if cnt:
+                if cnt and not first_round:
                     self._c_spec_tok.inc(cnt)
                     self._c_spec_acc.inc(max(0, cnt - 1))
                     if req.speculative and gamma > 0:
@@ -1700,6 +2049,10 @@ class Scheduler:
         if req.slot is not None:
             self.alloc.release(req.slot)
             self.engine.reset_slot(req.slot)
+            # mixed carries: plen 0 marks the freed slot decode-phase
+            # (a stale cursor then compares >= 0 and never re-enters
+            # prefill); readmission reseeds both
+            self._plen_host[req.slot] = 0
             self.slots[req.slot] = None
             req.slot = None
         if req in self.running:
@@ -1795,6 +2148,7 @@ class Scheduler:
         req.preemptions += 1
         self.alloc.release(req.slot)
         self.engine.reset_slot(req.slot)
+        self._plen_host[req.slot] = 0  # mixed carries: decode-phase
         self.slots[req.slot] = None
         req.slot = None
         if req in self.running:

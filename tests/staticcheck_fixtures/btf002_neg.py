@@ -99,3 +99,31 @@ class DraftEngine:
             params, self._hist, self.cache, self._draft_state)
         self._hist, self.cache, self._draft_state = hist, cache, dstate
         return toks, self._draft_state.length
+
+
+def _step_mixed(params, toks, cursor, cache, pbuf):
+    return toks, toks, cursor, cache
+
+
+class MixedEngine:
+    """Blessed mixed-dispatch pattern (ISSUE 18): every donated carry —
+    the prefill chunk-offset cursor AND the cache — rebinds from the
+    result before any later read; the prompt buffer is NOT donated, so
+    reading (or host-editing) it after the dispatch is clean
+    (serving.py mixed_block_async)."""
+
+    def __init__(self):
+        self._mixed_progs = {}
+
+    def _mixed_prog(self, k):
+        prog = self._mixed_progs.get(k)
+        if prog is None:
+            prog = jax.jit(_step_mixed, donate_argnums=(2, 3))
+            self._mixed_progs[k] = prog
+        return prog
+
+    def mixed_dispatch(self, params, toks, k):
+        blk, fin, cursor, cache = self._mixed_prog(k)(
+            params, toks, self._cursor, self.cache, self._pbuf)
+        self._cursor, self.cache = cursor, cache
+        return blk, self._cursor, self._pbuf  # all rebound / non-donated

@@ -1,6 +1,6 @@
 """BTF002 positive fixture: reads of donated references after dispatch.
 
-Expected findings: 5 —
+Expected findings: 6 —
 * a read of the donated cache in the statement after the dispatch,
 * the same handle re-passed on the next loop iteration without rebind,
 * a read of a tree donated to a locally-built donating jit,
@@ -9,7 +9,10 @@ Expected findings: 5 —
   reads the donated window attribute afterwards,
 * a spec-block dispatch (ISSUE 14: factory program donating the
   history carry AND the draft-model KV cache) that rebinds the
-  history but reads the donated draft cache afterwards.
+  history but reads the donated draft cache afterwards,
+* a mixed-dispatch block (ISSUE 18: factory program donating the
+  per-slot prefill chunk-offset cursor alongside the cache) that
+  rebinds the cache but reads the stale cursor afterwards.
 """
 import jax
 
@@ -93,3 +96,29 @@ class DraftEngine:
         self._hist = hist               # history rebound...
         self.cache = cache
         return toks, self._draft_state  # finding 5: draft NOT rebound
+
+
+def _step_mixed(params, toks, cursor, cache, pbuf):
+    return toks, toks, cursor, cache
+
+
+class MixedEngine:
+    """The mixed-dispatch carry (ISSUE 18): one program donates the
+    per-slot prefill chunk-offset cursor AND the cache (serving.py's
+    _mixed_block_prog shape); the prompt buffer is not donated."""
+
+    def __init__(self):
+        self._mixed_progs = {}
+
+    def _mixed_prog(self, k):
+        prog = self._mixed_progs.get(k)
+        if prog is None:
+            prog = jax.jit(_step_mixed, donate_argnums=(2, 3))
+            self._mixed_progs[k] = prog
+        return prog
+
+    def stale_cursor_read(self, params, toks, k):
+        blk, fin, cursor, cache = self._mixed_prog(k)(
+            params, toks, self._cursor, self.cache, self._pbuf)
+        self.cache = cache          # cache rebound...
+        return blk, self._cursor    # finding 6: cursor NOT rebound

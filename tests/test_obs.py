@@ -593,7 +593,7 @@ def _synthetic_ticks(path, n=12):
         phases = {"expire": 0.0001, "drain_oldest": 0.001,
                   "drain_barrier": 0.002 if i % 3 == 0 else 0.0,
                   "admit": 0.003, "assemble": 0.0005,
-                  "dispatch": 0.004, "spec_emit": 0.0,
+                  "dispatch": 0.004, "mixed": 0.005, "spec_emit": 0.0,
                   "flush": 0.0002, "other": 0.0008}
         wall = sum(phases.values())
         log.record(wall, phases, fetch_s=0.0015, inflight=2,
@@ -622,6 +622,9 @@ def test_tick_report_stats_and_reconciliation(tmp_path):
     assert s["barrier_causes"] == {"admission": 4}
     text = mod.render(dump)
     assert "dispatch" in text and "barriers by cause" in text
+    # the top-terms table speaks the mixed-dispatch vocabulary: the
+    # fused phase renders with its glossary note
+    assert "mixed" in text and "ONE fused dispatch" in text
     # a non-dump file is a loud error
     bad = tmp_path / "bad.json"
     bad.write_text("[1,2]")

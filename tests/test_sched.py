@@ -174,7 +174,10 @@ def test_oversized_request_rejected_at_submit():
 
 
 def test_cancel_running_request_frees_resources():
-    sched, _ = make_sched()
+    # mixed_dispatch=False: documents the ALTERNATING path's cadence
+    # (prefill completes inside the admission tick); the fused-path
+    # twins live in test_mixed_dispatch.py
+    sched, _ = make_sched(mixed_dispatch=False)
     r1 = sched.submit([5, 7], max_new_tokens=50)
     r2 = sched.submit([3], max_new_tokens=4)
     sched.tick()
@@ -239,7 +242,8 @@ def test_cancel_mid_prefill_frees_resources():
 def test_decode_steps_per_tick():
     # inflight_blocks=1: the synchronous drain-every-tick cadence this
     # test documents (the pipelined cadence has its own tests below)
-    sched, params = make_sched(decode_steps_per_tick=3, inflight_blocks=1)
+    sched, params = make_sched(decode_steps_per_tick=3, inflight_blocks=1,
+                               mixed_dispatch=False)
     req = sched.submit([5, 7, 11], max_new_tokens=10)
     # admission samples the first token on-device and the tick's 3
     # decode steps are dispatched chained on it; everything drains in
@@ -533,8 +537,12 @@ def test_batched_prefill_parity():
     [B, Tbucket] dispatch produce token-for-token the same outputs as
     sequential single-slot prefill (prefill_max_batch=1) and as the
     offline reference, across members with different prompt lengths."""
-    seq, params = make_sched(max_batch=4, max_seq=64, prefill_max_batch=1)
-    gang, _ = make_sched(max_batch=4, max_seq=64, prefill_max_batch=4)
+    # alternating path: batched prefill dispatches only exist there
+    # (mixed dispatch rides prompts inside the fused decode block)
+    seq, params = make_sched(max_batch=4, max_seq=64, prefill_max_batch=1,
+                             mixed_dispatch=False)
+    gang, _ = make_sched(max_batch=4, max_seq=64, prefill_max_batch=4,
+                         mixed_dispatch=False)
     prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
     want = [seq.submit(p, max_new_tokens=10) for p in prompts]
     seq.run_until_done()
@@ -554,7 +562,8 @@ def test_gang_admission_single_tick():
     """A burst of waiting requests is admitted AND fully prefilled in
     one tick when budget and slots allow — the gang property that cuts
     burst TTFT (previously: one [1, T] dispatch per prompt)."""
-    sched, _ = make_sched(max_batch=4, prefill_max_batch=4)
+    sched, _ = make_sched(max_batch=4, prefill_max_batch=4,
+                          mixed_dispatch=False)
     reqs = [sched.submit([i + 1, i + 2], max_new_tokens=4)
             for i in range(4)]
     sched.tick()
@@ -589,7 +598,8 @@ def test_mixed_warm_cold_group_admission():
     for warm_flash in (True, False):
         sched, params = make_sched(max_batch=4, max_seq=64, page=8,
                                    prefix_caching=True, prefill_max_batch=4,
-                                   prefill_flash_warm=warm_flash)
+                                   prefill_flash_warm=warm_flash,
+                                   mixed_dispatch=False)
         shared = list(range(1, 17))  # two full pages
         r0 = sched.submit(shared + [5], max_new_tokens=4)
         sched.run_until_done()
@@ -636,7 +646,7 @@ def test_prefill_group_member_is_preemption_victim():
     members: the youngest live request loses page pressure even if it
     is still prefilling (it cannot starve an older decoding request)."""
     sched, params = make_sched(max_batch=2, max_seq=32, page=4, num_pages=6,
-                               prefill_chunk=4)
+                               prefill_chunk=4, mixed_dispatch=False)
     r1 = sched.submit([5, 7, 11], max_new_tokens=12)
     sched.tick()
     sched.tick()
@@ -654,7 +664,9 @@ def test_pending_first_set_tracks_drain():
     """The (id, preemptions)-keyed index over undrained first tokens is
     populated at admission and refreshed (cleared) at drain time — the
     budget computation reads it instead of scanning the pending list."""
-    sched, _ = make_sched(inflight_blocks=1)  # per-tick drain cadence
+    # alternating path: _pending_first only exists there (mixed
+    # dispatch samples completion first tokens inside the fused block)
+    sched, _ = make_sched(inflight_blocks=1, mixed_dispatch=False)
     req = sched.submit([5, 7, 11], max_new_tokens=4)
     sched.tick()
     assert (req.id, req.preemptions) in sched._pending_first_keys
@@ -704,7 +716,8 @@ def test_pipelined_lazy_drain_cadence():
     """Steady state at inflight_blocks=2: block t+1 is dispatched while
     block t is still undrained; the host fetches only once the queue is
     full (the dispatch-ahead overlap, made visible by token timing)."""
-    sched, params = make_sched(decode_steps_per_tick=2, inflight_blocks=2)
+    sched, params = make_sched(decode_steps_per_tick=2, inflight_blocks=2,
+                               mixed_dispatch=False)
     req = sched.submit([5, 7, 11], max_new_tokens=12)
     sched.tick()  # admit + first token (pending) + dispatch block 1
     assert len(req.output) == 0 and len(sched._inflight) == 1
@@ -721,7 +734,10 @@ def test_pipelined_admission_forces_drain_barrier():
     """A waiter with a free slot forces a FULL drain barrier before
     admission: every in-flight block reconciles, then the gang admits
     in the same tick."""
-    sched, params = make_sched(max_batch=2, inflight_blocks=2)
+    # alternating path: the admission barrier class this documents is
+    # exactly what mixed dispatch (the default) retires
+    sched, params = make_sched(max_batch=2, inflight_blocks=2,
+                               mixed_dispatch=False)
     r1 = sched.submit([5, 7, 11], max_new_tokens=16)
     sched.tick()
     sched.tick()
@@ -762,7 +778,7 @@ def test_page_pressure_drains_before_preempting():
     FULL drain barrier runs before any victim is chosen — preemption
     must never reclaim pages a dispatched block still writes to."""
     sched, _ = make_sched(max_batch=2, max_seq=32, page=4, num_pages=6,
-                          inflight_blocks=2)
+                          inflight_blocks=2, mixed_dispatch=False)
     r1 = sched.submit([5, 7, 11], max_new_tokens=20)
     r2 = sched.submit([3, 1], max_new_tokens=20)
     sched.tick()
@@ -814,7 +830,9 @@ def test_scheduler_trace_timeline():
     from butterfly_tpu.obs.trace import Tracer
     model = Model(CFG)
     params = model.init(jax.random.PRNGKey(42))
-    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8)
+    # alternating path: prefill_chunk trace events only exist there
+    rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                       mixed_dispatch=False)
     tr = Tracer()
     sched = Scheduler(ServingEngine(model, params, rt), tracer=tr)
     req = sched.submit([5, 7, 11], max_new_tokens=4,
@@ -884,7 +902,8 @@ def test_written_counts_undrained_first_token():
     written — _written must not subtract one (it loses a page of
     prefix-cache registration at page boundaries)."""
     sched, _ = make_sched(max_batch=2, max_seq=64, page=8,
-                          inflight_blocks=1)  # per-tick drain cadence
+                          inflight_blocks=1,  # per-tick drain cadence
+                          mixed_dispatch=False)  # alternating cadence
     req = sched.submit([1] * 8, max_new_tokens=4)  # exactly one page
     sched.tick()  # admit + prefill + on-device first sample (undrained)
     assert req.state == "running" and req.output == []
@@ -929,7 +948,7 @@ def test_deadline_expired_while_running():
     barrier — it never consumes a decode dispatch after expiry — while
     a co-running request decodes on unharmed."""
     import time
-    sched, params = make_sched(max_batch=2)
+    sched, params = make_sched(max_batch=2, mixed_dispatch=False)
     doomed = sched.submit([5, 7, 11], max_new_tokens=50)
     ok = sched.submit([3, 1], max_new_tokens=8)
     sched.tick()
@@ -1019,8 +1038,13 @@ def test_kv_window_off_matches_on():
     to the per-token write path — and only the window mode populates
     the flush instruments."""
     prompts = [[5, 7, 11], [3, 1]]
-    on, _ = make_sched(max_batch=2)  # kv_write_combine defaults on
-    off, _ = make_sched(max_batch=2, kv_write_combine=False)
+    # alternating path: the flushed-token arithmetic below assumes
+    # prompts land via dedicated prefill scatters (under mixed dispatch
+    # prompt K/V stages through the window too; parity twins in
+    # test_mixed_dispatch.py)
+    on, _ = make_sched(max_batch=2, mixed_dispatch=False)
+    off, _ = make_sched(max_batch=2, kv_write_combine=False,
+                        mixed_dispatch=False)
     a = [on.submit(p, max_new_tokens=10) for p in prompts]
     b = [off.submit(p, max_new_tokens=10) for p in prompts]
     on.run_until_done()
@@ -1187,7 +1211,9 @@ def test_tick_anatomy_ring_and_phase_reconciliation():
     blocks are in flight."""
     from butterfly_tpu.obs.ticklog import TICK_PHASES
 
-    sched, params = make_sched(max_batch=2)
+    # alternating path: the admission barrier-cause assertion below is
+    # the behavior mixed dispatch (the default) retires
+    sched, params = make_sched(max_batch=2, mixed_dispatch=False)
     r1 = sched.submit([5, 7, 11], max_new_tokens=12)
     for _ in range(3):
         sched.tick()  # fill the dispatch-ahead pipeline

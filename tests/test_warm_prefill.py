@@ -123,10 +123,15 @@ def _prompts(seed, lens):
 
 def _run(model, params, prompts, *, use_kernels, warm_flash, kv_quant="none",
          chunk=16, max_new=8, prefix_caching=False, resume=None):
+    # mixed_dispatch=False: this file exercises the ALTERNATING path's
+    # batched warm/dense prefill programs (under mixed dispatch, the
+    # default, prompts ride the fused decode block and prefill_batch
+    # never dispatches — test_mixed_dispatch.py covers that path)
     rt = RuntimeConfig(max_batch_size=4, max_seq_len=128, page_size=8,
                        prefill_chunk=chunk, prefill_max_batch=4,
                        prefill_flash_warm=warm_flash, kv_quant=kv_quant,
-                       prefix_caching=prefix_caching)
+                       prefix_caching=prefix_caching,
+                       mixed_dispatch=False)
     sched = Scheduler(ServingEngine(model, params, rt,
                                     use_kernels=use_kernels))
     reqs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]

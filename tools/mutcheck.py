@@ -150,11 +150,13 @@ MUTANTS = [
      "pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(A), g_flat]",
      "pos = 0 * (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(A), g_flat]",
      ["tests/test_expert.py"], {}),
-    # speculative scheduler: length rollback off by one (the first
-    # rejected position's stale K/V becomes attendable)
-    ("butterfly_tpu/sched/scheduler.py",
-     "vals[slot] = len(req.all_tokens) - 1",
-     "vals[slot] = len(req.all_tokens)",
+    # speculative serving scan: length rollback off by one (the first
+    # rejected position's stale K/V becomes attendable). The anchor
+    # used to live in the scheduler's host accept loop; it moved into
+    # the on-device scan when acceptance did.
+    ("butterfly_tpu/engine/serving.py",
+     "cache = cache._replace(lengths=jnp.where(live, W + m, W))",
+     "cache = cache._replace(lengths=jnp.where(live, W + m + 1, W))",
      ["tests/test_sched.py"], {}),
     # write-combined KV window (ISSUE 12): drop the flush's K-pool
     # scatter — staged K bytes never land, so after a drain the pool
@@ -255,6 +257,17 @@ MUTANTS = [
      "if h in consumed or h in new:",
      "if h in consumed and h in new:",
      ["tests/test_staticcheck.py"], {}),
+    # mixed dispatch (ISSUE 18): drop the prefill_inline_budget bound —
+    # every waiting request would enter prefill phase at once, so one
+    # fused scan step chews an unbounded number of prompt tokens while
+    # every decode slot waits on that step's forward (exactly the ITL
+    # tail the knob exists to cap). Killed by the inline-budget cap
+    # test in tests/test_mixed_dispatch.py (concurrent prefill lanes
+    # must never exceed prefill_inline_budget // chunk_width).
+    ("butterfly_tpu/sched/scheduler.py",
+     "self._mixed_max_pf = max(1, rt.prefill_inline_budget // self._mixed_chunk)",
+     "self._mixed_max_pf = engine.num_slots",
+     ["tests/test_mixed_dispatch.py"], {}),
     # elastic fleet (ISSUE 17): invert the scale-down hysteresis guard —
     # a shrink would be HELD only after the quiet window and allowed
     # inside it, so a grow->shrink->grow flap pays the warmup on every

@@ -3,10 +3,13 @@
 Past incident class: every decode/prefill/spec dispatch donates the KV
 pools (and the spec block donates the device token-history carry; the
 write-combined windowed blocks additionally donate the staged-window
-buffer + per-slot staged count — ISSUE 12's window carry — and under a
+buffer + per-slot staged count — ISSUE 12's window carry — under a
 model draft source the spec block also donates the draft model's own
-KV cache, ISSUE 14's draft-cache carry; all the same factory pattern)
-so XLA updates them in place. A host-side read of the donated reference
+KV cache, ISSUE 14's draft-cache carry, and the mixed-dispatch blocks
+donate the per-slot prefill CURSOR carry — ISSUE 18's chunk-offset
+vector, rebound from every mixed_block_async /
+mixed_spec_block_async result; all the same factory pattern) so XLA
+updates them in place. A host-side read of the donated reference
 after the dispatch call observes freed/aliased memory — under paged
 serving this aliases garbage K/V under a valid page id, silently
 (PR 5's "in-flight writes must never land on reclaimed pages" is the
@@ -42,10 +45,17 @@ from . import (FileContext, Finding, Rule, assigned_handles, handle_of,
 #: OF THE CALLER'S argument list. ServingEngine.spec_block_async donates
 #: its ``hist`` argument (engine/serving.py jit donate_argnums=(1,)
 #: shifted past the bound params); cast_params donates the source tree.
+#: The mixed-dispatch blocks (ISSUE 18) donate the per-slot prefill
+#: cursor carry — mixed_block_async its ``cursor`` (caller index 1),
+#: mixed_spec_block_async its ``hist`` and ``cursor`` (0 and 2); the
+#: prompt buffer is deliberately NOT donated (the scheduler edits it
+#: host-side between dispatches at admission).
 #: decode_block_async / decode_active_async donate only the engine's own
 #: self.cache, never a caller argument, so they are absent by design.
 KNOWN_DONATING_METHODS: Dict[str, Tuple[int, ...]] = {
     "spec_block_async": (0,),
+    "mixed_block_async": (1,),
+    "mixed_spec_block_async": (0, 2),
     "cast_params": (0,),
 }
 

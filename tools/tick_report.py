@@ -28,6 +28,23 @@ import urllib.request
 from typing import Dict, List
 
 
+#: one-line glossary for the structural phase vocabulary — the table's
+#: top terms should be self-explaining in a report pasted into an issue
+PHASE_NOTES = {
+    "expire": "deadline scrub over waiting + running",
+    "drain_oldest": "lazy drain of the oldest in-flight block",
+    "drain_barrier": "FULL drain (membership change forced it)",
+    "admit": "admission: slot grant + prompt staging",
+    "assemble": "per-tick operand assembly for the batch",
+    "dispatch": "alternating-path prefill/decode dispatch",
+    "mixed": "ONE fused dispatch: prefill chunks + decode/spec "
+             "blocks together (mixed_dispatch, the default)",
+    "spec_emit": "host accept/emit walk over drafted tokens",
+    "flush": "write-combined KV window flush",
+    "other": "unattributed residual of the tick wall",
+}
+
+
 def load_dump(path: str) -> dict:
     with open(path) as f:
         dump = json.load(f)
@@ -95,11 +112,12 @@ def render(dump: dict) -> str:
                  f"{100 * s['device_frac']:.1f}% of tick wall")
     lines.append("")
     lines.append(f"{'phase':>14} {'total_s':>10} {'share':>7} "
-                 f"{'p50_s':>10} {'p95_s':>10}")
+                 f"{'p50_s':>10} {'p95_s':>10}  note")
     for p in s["phases"]:
         lines.append(f"{p['phase']:>14} {p['total_s']:>10.4f} "
                      f"{100 * p['share']:>6.1f}% "
-                     f"{p['p50_s']:>10.5f} {p['p95_s']:>10.5f}")
+                     f"{p['p50_s']:>10.5f} {p['p95_s']:>10.5f}  "
+                     f"{PHASE_NOTES.get(p['phase'], '')}")
     lines.append("")
     lines.append(f"phase sums account for "
                  f"{100 * s['reconciliation']:.1f}% of tick wall")
