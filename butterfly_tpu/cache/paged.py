@@ -394,13 +394,96 @@ def flush_paged_window(cache: PagedKVCache, window: KVWindow, win_len):
     return cache, jnp.zeros_like(win_len), win_len.sum()
 
 
+def permute_window_tail(window: KVWindow, win_len, perm) -> KVWindow:
+    """Compact a tree round's accepted path inside the window: the
+    round staged its N chunk entries at window indices win_len ..
+    win_len+N-1 (chunk-index order); `perm` [S, C] gives, for each of
+    the C kept positions, the CHUNK index whose K/V belongs there
+    (sampling.speculative_tree_accept's perm — the root->leaf accepted
+    path). After this, window index win_len+i holds the i-th kept
+    node's K/V, so the caller's win_len += m advance makes exactly the
+    accepted path attendable/flushable and the rejected branches die
+    past win_len, rollback-exact as ever.
+
+    Pure gather (take_along_axis along the W axis with an identity
+    index outside the staged run): the source materializes before the
+    write, so overlapping src/dst positions are safe, and entries past
+    the kept count are just the permuted leftovers — past win_len+m,
+    unattendable, overwritten by the next round's staging."""
+    W = window.width
+    C = perm.shape[1]
+    ar = jnp.arange(W)[None, :]                         # [1, W]
+    rel = ar - win_len[:, None]                         # [S, W]
+    tail = jnp.take_along_axis(perm, jnp.clip(rel, 0, C - 1), axis=1)
+    idx = jnp.where((rel >= 0) & (rel < C),
+                    win_len[:, None] + tail, ar)        # [S, W]
+    gather = lambda a: jnp.take_along_axis(             # noqa: E731
+        a, idx[None, :, None, :, None], axis=3)         # [L,S,Kv,W,H]
+    k, v = gather(window.k), gather(window.v)
+    ks = vs = None
+    if window.quantized:
+        gs = lambda a: jnp.take_along_axis(             # noqa: E731
+            a, idx[None, :, None, :], axis=3)           # [L,S,Kv,W]
+        ks, vs = gs(window.k_scale), gs(window.v_scale)
+    return KVWindow(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def permute_paged_tail(cache: PagedKVCache, perm, active=None
+                       ) -> PagedKVCache:
+    """Window-off twin of permute_window_tail: the tree round's N chunk
+    entries were written straight into the page pool at absolute
+    positions lengths .. lengths+N-1 (write_paged_layer's start + chunk
+    index); gather the C kept nodes' entries (positions lengths +
+    perm[:, i]) and scatter them to the contiguous accepted positions
+    lengths .. lengths+C-1 across all layers. Entries past the kept
+    count m land past the advanced length — unattendable, overwritten
+    by the next round's chunk at its new base. Inactive slots scatter
+    to the null page (write_paged_layer's redirect)."""
+    L, Pp, Kv, page, H = cache.k_pages.shape
+    S, C = perm.shape
+    mp = cache.page_table.shape[1]
+    base = cache.lengths[:, None]                        # [S, 1]
+
+    def flat(pos):
+        pi = jnp.take_along_axis(cache.page_table,
+                                 jnp.clip(pos // page, 0, mp - 1), axis=1)
+        pi = jnp.where(pos < mp * page, pi, Pp - 1)
+        if active is not None:
+            pi = jnp.where(active[:, None], pi, Pp - 1)
+        return pi.reshape(-1), (pos % page).reshape(-1)
+
+    src_pages, src_off = flat(base + perm)
+    dst_pages, dst_off = flat(base + jnp.arange(C)[None, :])
+    # advanced indices at dims 1 and 3 (slice between) put the index
+    # dim FIRST: values move as [S*C, L, Kv, H] (flush_paged_window's
+    # idiom); the gather materializes before the scatter, so the
+    # overlapping in-place permute is safe
+    k_pages = cache.k_pages.at[:, dst_pages, :, dst_off].set(
+        cache.k_pages[:, src_pages, :, src_off])
+    v_pages = cache.v_pages.at[:, dst_pages, :, dst_off].set(
+        cache.v_pages[:, src_pages, :, src_off])
+    ksp, vsp = cache.k_scale_pages, cache.v_scale_pages
+    if cache.quantized:
+        # flat scale dim is kv-major: col = kv*page + offset; adjacent
+        # advanced dims (1, 2) stay in place: values move [L, S*C, Kv]
+        src_cols = jnp.arange(Kv)[None, :] * page + src_off[:, None]
+        dst_cols = jnp.arange(Kv)[None, :] * page + dst_off[:, None]
+        ksp = ksp.at[:, dst_pages[:, None], dst_cols].set(
+            ksp[:, src_pages[:, None], src_cols])
+        vsp = vsp.at[:, dst_pages[:, None], dst_cols].set(
+            vsp[:, src_pages[:, None], src_cols])
+    return cache._replace(k_pages=k_pages, v_pages=v_pages,
+                          k_scale_pages=ksp, v_scale_pages=vsp)
+
+
 # ---------------------------------------------------------------------------
 # Paged forward pass (reference path; Pallas decode kernel lives in ops/)
 # ---------------------------------------------------------------------------
 
 def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
                      positions, mask, cos, sin, active, use_kernel: bool,
-                     fresh: bool, ksp=None, vsp=None, win=None):
+                     fresh: bool, ksp=None, vsp=None, win=None,
+                     force_dense: bool = False):
     """One transformer layer against one layer's page pool slice.
 
     Shared by paged_forward's full-stack scan, the stage-local scan of
@@ -459,7 +542,8 @@ def paged_layer_body(x, lp, kp, vp, *, cfg: ModelConfig, page_table,
         # fresh prefill attends over the just-projected bf16 K/V, so the
         # kernel path is identical for int8 pools
         out = flash_attention_sharded(q, k, v, causal=True)
-    elif cfg.attn_impl == "flash" and T > 1 and win is None:
+    elif cfg.attn_impl == "flash" and T > 1 and win is None \
+            and not force_dense:
         # warm chunked prefill (ISSUE 13): the kernel attends the
         # CACHED prefix — the gathered pool view, count-masked per row
         # at the chunk's start (so the chunk's own just-written copy,
@@ -523,7 +607,8 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
                   active: Optional[jax.Array] = None,
                   use_kernel: bool = False,
                   fresh: bool = False,
-                  last_index: Optional[jax.Array] = None):
+                  last_index: Optional[jax.Array] = None,
+                  attn_mask: Optional[jax.Array] = None):
     """Forward over [B,T] tokens against the paged cache.
 
     B must equal cache.num_slots (serving: one row per slot). `active`
@@ -540,6 +625,15 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
     last_index [B]: run the LM head only on each row's hidden state at
     that index — logits come back [B,1,V] (models.common.forward docs:
     the full-T head dominates prefill memory at LLM vocab sizes).
+
+    attn_mask [B,T,S_max]: replace the causal make_mask with an
+    explicit attention mask (the tree-verify path: each node attends
+    committed history + its ancestor chunk positions only). Forces the
+    dense gather path — the chunk is NOT causal, so neither flash
+    branch may see it. K/V writes still land at start + chunk index
+    (write_paged_layer's arange), while RoPE follows `positions`
+    (base + tree depth): after the accepted-path compaction the kept
+    entries' storage positions equal their RoPE positions again.
     """
     B, T = tokens.shape
     quant = cache.quantized
@@ -549,7 +643,8 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
         active = jnp.ones((B,), bool)
 
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
-    mask = make_mask(positions, cache.max_seq)
+    mask = attn_mask if attn_mask is not None \
+        else make_mask(positions, cache.max_seq)
     mask = mask & active[:, None, None]
 
     def body(x, scanned):
@@ -559,7 +654,8 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
             positions=positions, mask=mask, cos=cos, sin=sin, active=active,
             use_kernel=use_kernel, fresh=fresh,
             ksp=scales[0] if scales else None,
-            vsp=scales[1] if scales else None)
+            vsp=scales[1] if scales else None,
+            force_dense=attn_mask is not None)
         return out[0], tuple(out[1:])
 
     xs = (params["layers"], cache.k_pages, cache.v_pages)
@@ -578,7 +674,9 @@ def paged_forward(params, cfg: ModelConfig, tokens: jax.Array,
 def paged_forward_window(params, cfg: ModelConfig, tokens: jax.Array,
                          cache: PagedKVCache, window: KVWindow, win_len,
                          active: Optional[jax.Array] = None,
-                         use_kernel: bool = False):
+                         use_kernel: bool = False,
+                         positions: Optional[jax.Array] = None,
+                         attn_mask: Optional[jax.Array] = None):
     """Windowed (kv_write_combine) forward over [B,T] tokens: the pool
     is READ-ONLY, fresh K/V stages into `window` at per-slot offset
     win_len, and attention reads pool + window.
@@ -596,14 +694,23 @@ def paged_forward_window(params, cfg: ModelConfig, tokens: jax.Array,
     la models/common._decode_forward — threading the read-only pools
     through scan xs would materialize a layer-slice copy per step. Only
     the small window leaves ride the scan as xs/ys.
+
+    `positions`/`attn_mask` override the causal defaults for the
+    tree-verify path (paged_forward's attn_mask docs): staging still
+    lands token t at window index win_len + t, positions carry
+    base + tree depth for RoPE, and the explicit mask forces the dense
+    insert path.
     """
     B, T = tokens.shape
     quant = cache.quantized
     if active is None:
         active = jnp.ones((B,), bool)
-    positions = (cache.lengths + win_len)[:, None] + jnp.arange(T)[None, :]
+    if positions is None:
+        positions = (cache.lengths + win_len)[:, None] \
+            + jnp.arange(T)[None, :]
     x, cos, sin = embed_tokens(params, cfg, tokens, positions)
-    mask = make_mask(positions, cache.max_seq)
+    mask = attn_mask if attn_mask is not None \
+        else make_mask(positions, cache.max_seq)
     mask = mask & active[:, None, None]
 
     def body(carry, scanned):

@@ -343,6 +343,30 @@ class RuntimeConfig:
                                       # through the existing ckpt
                                       # machinery instead of deriving
                                       # by truncation
+    spec_tree_width: int = 0          # token-TREE speculation
+                                      # (SpecInfer-style): branch this
+                                      # many sibling candidates from the
+                                      # draft's per-position q at every
+                                      # expansion depth and verify the
+                                      # whole tree in ONE forward per
+                                      # round via a tree-attention mask
+                                      # (engine._spec_tree_scan). The
+                                      # recursive-residual rejection
+                                      # walk keeps the output
+                                      # distribution exactly the
+                                      # target's. Requires a draft
+                                      # source with tree_draft (the
+                                      # "model" source). 0/1 = linear
+                                      # γ-chain speculation (the
+                                      # speculative_gamma path)
+    spec_tree_nodes: int = 0          # total node budget N of the token
+                                      # tree, INCLUDING the root chain
+                                      # token ((N-1) must be divisible
+                                      # by spec_tree_width — full
+                                      # sibling fans only). 0 = auto:
+                                      # γ+1 nodes, so tree-vs-linear
+                                      # comparisons at the same gamma
+                                      # hold verify FLOPs equal
     top_k: int = 0                    # serving-wide sampling filters
     top_p: float = 1.0
     port: int = 8000

@@ -56,10 +56,12 @@ from jax import lax
 
 from butterfly_tpu.cache.paged import (
     KVWindow, PagedKVCache, flush_paged_window, init_kv_window,
-    init_paged_cache, paged_forward, paged_forward_window)
+    init_paged_cache, paged_forward, paged_forward_window,
+    permute_paged_tail, permute_window_tail)
 from butterfly_tpu.core.config import ModelConfig, RuntimeConfig
 from butterfly_tpu.engine.sampling import (
-    _filter_logits, speculative_accept)
+    _filter_logits, speculative_accept, speculative_tree_accept,
+    tree_ancestor_matrix, tree_depth, tree_node_index)
 from butterfly_tpu.models.common import Model
 
 
@@ -350,6 +352,12 @@ class ServingEngine:
         # "model" source also builds its draft weights (truncation or
         # --draft-ckpt) and allocates its KV carry here.
         self._spec_blocks: Dict[int, object] = {}
+        # Tree speculation (ISSUE 19): SpecInfer-style token-tree
+        # programs, one per round count like the linear pair above.
+        self._spec_tree_blocks: Dict[int, object] = {}
+        self._spec_tree_win_blocks: Dict[int, object] = {}
+        self._tree_width = 0
+        self._tree_nodes = 0
         self._draft_stateful = False
         self._draft_state = None
         if self.runtime.speculative_gamma > 0:
@@ -370,6 +378,29 @@ class ServingEngine:
             if self._draft_stateful:
                 with self._mesh_ctx():
                     self._draft_state = self._draft_src.init_state()
+            if self.runtime.spec_tree_width >= 2:
+                w = self.runtime.spec_tree_width
+                # default node budget γ+1: tree-vs-linear comparisons
+                # at the same gamma hold verify FLOPs equal
+                n = self.runtime.spec_tree_nodes \
+                    or (self.runtime.speculative_gamma + 1)
+                if n < w + 1 or (n - 1) % w != 0:
+                    raise ValueError(
+                        f"spec_tree_nodes={n} invalid for width {w}: "
+                        f"need n >= width+1 and (n-1) divisible by "
+                        f"width (full sibling fans only)")
+                if not hasattr(self._draft_src, "tree_draft"):
+                    raise ValueError(
+                        f"spec_tree_width requires a draft source with "
+                        f"tree_draft (the 'model' source); "
+                        f"{self.runtime.draft_model!r} has none")
+                if stage > 1:
+                    raise ValueError(
+                        "tree speculation does not compose with "
+                        "pipeline (stage > 1) serving: the tree-mask "
+                        "verify rides paged_forward's attn_mask, which "
+                        "the stage-local pipeline scan has no slot for")
+                self._tree_width, self._tree_nodes = w, n
 
     def _mesh_ctx(self):
         import contextlib
@@ -683,15 +714,53 @@ class ServingEngine:
         return block, final
 
     @property
+    def spec_tree_mode(self) -> bool:
+        """Token-tree speculation on: spec rounds draft a width-w node
+        tree and verify it in one tree-masked forward (ISSUE 19)."""
+        return self._tree_nodes > 0
+
+    @property
+    def spec_tree_geometry(self) -> Tuple[int, int]:
+        """(width, nodes) of the validated tree — (0, 0) off."""
+        return self._tree_width, self._tree_nodes
+
+    @property
+    def spec_emit_width(self) -> int:
+        """Max tokens a spec round can emit per slot — the C dimension
+        of spec_block_async's (toks, valid) stack and the scheduler's
+        budget/reshape unit. Linear: gamma drafts + 1 correction.
+        Tree: the max-depth accepted path (D nodes) + 1 correction —
+        the node budget N is a VERIFY width, not an emission width."""
+        if self.spec_tree_mode:
+            return tree_depth(self._tree_width, self._tree_nodes) + 1
+        return self.runtime.speculative_gamma + 1
+
+    @property
     def mixed_dispatch_ready(self) -> bool:
         """Can the scheduler route this engine through mixed blocks?
         RuntimeConfig.mixed_dispatch on AND a stateless draft source —
         a stateful ("model") source's admission reseed hook
         (draft_prefill) is a host-side call that needs the drain
         barrier mixed dispatch deletes, so it keeps the alternating
-        path."""
+        path. Tree speculation also keeps the alternating path (no
+        fused mixed tree program — and its only in-tree source today
+        is the stateful "model" one anyway)."""
         return bool(self.runtime.mixed_dispatch) \
-            and not self._draft_stateful
+            and not self._draft_stateful and not self.spec_tree_mode
+
+    @property
+    def mixed_fallback_reason(self) -> Optional[str]:
+        """Why mixed_dispatch_ready is False DESPITE the config asking
+        for mixed dispatch — the scheduler surfaces this in metrics()
+        and counts the silent fallback (spec_mixed_fallback_total);
+        None when mixed is off by config or actually on."""
+        if not self.runtime.mixed_dispatch or self.mixed_dispatch_ready:
+            return None
+        if self._draft_stateful:
+            return ("stateful draft source "
+                    f"({self.runtime.draft_model!r}) needs the "
+                    "admission drain barrier for draft_prefill")
+        return "tree speculation has no fused mixed program"
 
     def _mixed_block_prog(self, k: int, C: int):
         prog = self._mixed_blocks.get((k, C))
@@ -865,6 +934,34 @@ class ServingEngine:
             self._spec_win_blocks[rounds] = prog
         return prog
 
+    def _spec_tree_prog(self, rounds: int):
+        """Tree twin of _spec_block_prog: same operand layout (tree
+        geometry replaces gamma/ngram in the closure), so the donation
+        set and static sampling filters line up column-for-column."""
+        prog = self._spec_tree_blocks.get(rounds)
+        if prog is None:
+            dn = (1, 3, 4) if self._draft_stateful else (1, 3)
+            prog = jax.jit(
+                partial(_spec_tree_scan, self.cfg, self._fwd, rounds,
+                        self._tree_width, self._tree_nodes,
+                        self._draft_src, use_kernel=self._use_kernels),
+                static_argnums=(9, 10), donate_argnums=dn)
+            self._spec_tree_blocks[rounds] = prog
+        return prog
+
+    def _spec_tree_win_prog(self, rounds: int):
+        """Tree twin of _spec_block_win_prog."""
+        prog = self._spec_tree_win_blocks.get(rounds)
+        if prog is None:
+            dn = (1, 3, 4, 5, 6) if self._draft_stateful else (1, 3, 5, 6)
+            prog = jax.jit(
+                partial(_spec_tree_scan_win, self.cfg, rounds,
+                        self._tree_width, self._tree_nodes,
+                        self._draft_src, use_kernel=self._use_kernels),
+                static_argnums=(11, 12), donate_argnums=dn)
+            self._spec_tree_win_blocks[rounds] = prog
+        return prog
+
     def spec_block_async(self, hist, hist_len, active: np.ndarray,
                          temps: np.ndarray, stops: np.ndarray,
                          budgets, spec_mask: np.ndarray, key: jax.Array,
@@ -894,14 +991,28 @@ class ServingEngine:
 
         Under a stateful draft source ("model") the draft KV cache
         rides the same carry: donated in, advanced per round by the
-        accepted count only (_draft_rollback), rebound here."""
+        accepted count only (_draft_rollback), rebound here.
+
+        spec_tree_mode routes the same operands through the TREE
+        programs (_spec_tree_scan[_win]): each round verifies an
+        N-node token tree in one tree-masked forward, the emission
+        width C becomes spec_emit_width (tree max-depth + 1), and the
+        window stages N entries per round of which only the accepted
+        path survives the in-window compaction."""
         self._sync_table()
+        tree = self.spec_tree_mode
         if self._window_mode:
-            C = self.runtime.speculative_gamma + 1
+            # per-round window demand is the VERIFY width: N staged
+            # tree nodes (rejected branches die unflushed), or the
+            # linear chunk gamma+1
+            C = self._tree_nodes if tree \
+                else self.runtime.speculative_gamma + 1
             self._ensure_window(rounds * C)
+            prog = self._spec_tree_win_prog(rounds) if tree \
+                else self._spec_block_win_prog(rounds)
             with self._mesh_ctx():
                 (toks, valid, hist, hist_len, rem, cache, window, wlen,
-                 dstate) = self._spec_block_win_prog(rounds)(
+                 dstate) = prog(
                         self.params, hist,
                         jnp.asarray(hist_len, jnp.int32), self.cache,
                         self._draft_state,
@@ -916,9 +1027,10 @@ class ServingEngine:
             self._win_dirty = True
             self._win_hwm += rounds * C
             return toks, valid, hist, hist_len, rem
+        prog = self._spec_tree_prog(rounds) if tree \
+            else self._spec_block_prog(rounds)
         with self._mesh_ctx():
-            toks, valid, hist, hist_len, rem, cache, dstate = \
-                self._spec_block_prog(rounds)(
+            toks, valid, hist, hist_len, rem, cache, dstate = prog(
                     self.params, hist, jnp.asarray(hist_len, jnp.int32),
                     self.cache, self._draft_state,
                     jnp.asarray(active, bool),
@@ -1311,6 +1423,212 @@ def _spec_scan_win(cfg: ModelConfig, rounds: int, gamma: int, ngram: int,
         # last emitted token (correction/bonus) is never staged,
         # decode-style — win_len is the rollback; the draft cache
         # rolls back by the same accepted count
+        wlen = jnp.where(live, wlen + m, wlen)
+        dst = _draft_rollback(dst, dlen0, live, m)
+        wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
+        cur = jnp.take_along_axis(hist, wpos, axis=1)
+        hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
+        hlen = jnp.where(live, hlen + m, hlen)
+        rem = jnp.where(live, rem - m, rem)
+        died = (valid & has_stop[:, None]
+                & (emitted == stops[:, None])).any(axis=1)
+        live = live & ~died & (rem > 0)
+        return (hist, hlen, win, wlen, dst, live, rem), (emitted, valid)
+
+    (hist, hist_len, window, win_len, dstate, _, rem), \
+        (toks_blk, valid_blk) = lax.scan(
+            body, (hist, hist_len, window, win_len, dstate, live0,
+                   budgets),
+            jnp.arange(rounds, dtype=jnp.int32))
+    return (toks_blk, valid_blk, hist, hist_len, rem, cache, window,
+            win_len, dstate)
+
+
+def _tree_chunk_operands(width: int, nodes: int, base, s_max: int):
+    """RoPE positions + tree-attention mask for one [S, N] tree-verify
+    chunk whose node 0 sits at absolute position `base` [S].
+
+    positions[s, n] = base[s] + depth(n): RoPE encodes TREE DEPTH while
+    the K/V write location stays base + chunk index (write_paged_layer
+    / stage_window_layer use arange(T)) — siblings share a RoPE
+    position but occupy distinct storage, and after the accepted-path
+    compaction the kept entries' storage positions equal their RoPE
+    positions again, indistinguishable from a linear decode.
+
+    mask[s, n, j]: node n attends absolute position j iff j is
+    committed history (j < base[s] — includes previously staged window
+    entries in the windowed path, whose base is flushed+staged) or j is
+    a chunk position on n's own root->n ancestor path
+    (tree_ancestor_matrix; self included). Everything else — sibling
+    branches above all — is invisible: collapsing this to all-ones is
+    the cross-branch attention leak the parity grid kills."""
+    depth = np.zeros((nodes,), np.int32)
+    for d in range(1, tree_depth(width, nodes) + 1):
+        for j in range(width):
+            depth[tree_node_index(d, j, width)] = d
+    positions = base[:, None] + jnp.asarray(depth)[None, :]   # [S, N]
+    anc = jnp.asarray(tree_ancestor_matrix(width, nodes))     # [N, N]
+    j_abs = jnp.arange(s_max)[None, :]                        # [1, Smax]
+    rel = j_abs - base[:, None]                               # [S, Smax]
+    tree_bits = anc[:, jnp.clip(rel, 0, nodes - 1)]           # [N,S,Smax]
+    mask = (j_abs < base[:, None])[:, None, :] \
+        | (((rel >= 0) & (rel < nodes))[:, None, :]
+           & jnp.transpose(tree_bits, (1, 0, 2)))
+    return positions, mask
+
+
+def _spec_tree_scan(cfg: ModelConfig, fwd, rounds: int, width: int,
+                    nodes: int, draft_src, params, hist, hist_len,
+                    cache: PagedKVCache, dstate, active, temps, stops,
+                    budgets, top_k: int, top_p: float, key, spec_mask,
+                    use_kernel: bool = False):
+    """Token-TREE twin of _spec_scan (ISSUE 19): each round drafts a
+    width-w, N-node candidate tree (draft_src.tree_draft — D = (N-1)/w
+    principal micro-steps, w i.i.d. samples per fan), verifies ALL N
+    nodes in ONE forward via the tree-attention mask
+    (_tree_chunk_operands: each node attends committed history + its
+    ancestor path only), and walks the recursive-residual accept on
+    device (sampling.speculative_tree_accept — the output law stays
+    exactly the target's). The per-round emission width is D+1 (the
+    max-depth path + correction/bonus), narrower than the verify width
+    N — that asymmetry is the whole bet: sibling branches hedge the
+    draft's uncertainty at equal verify FLOPs.
+
+    KV: the verify writes all N nodes' K/V at base + chunk index, then
+    permute_paged_tail gathers the accepted path to the contiguous
+    committed positions base..base+m-1 and the length rolls back to
+    base + m — rejected branches sit past the length and the next
+    round's N-wide chunk (N >= the stale run) rewrites them before any
+    query can attend that far, the same write-then-attend argument as
+    the linear scan. The draft cache rolls back to base + m too
+    (_draft_rollback); when the deepest accepted node is a
+    non-principal sibling its draft-KV entry holds the principal's K/V
+    instead (tree_draft docs) — bounded one-token context staleness,
+    never an exactness issue.
+
+    Liveness, truncation, history append, and the return contract are
+    _spec_scan's verbatim with C = D+1.
+    """
+    S, H = hist.shape
+    D = tree_depth(width, nodes)
+    C = D + 1
+    has_stop = stops >= 0
+    col = jnp.arange(C)[None, :]
+    rows = jnp.arange(S)[:, None]
+    last0 = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    live0 = active & (budgets > 0) \
+        & jnp.where(has_stop, last0 != stops, True)
+
+    def body(carry, i):
+        hist, hlen, cache, dst, live, rem = carry
+        dlen0 = dst.length if dst is not None else None
+        drafts, qlog, dst = draft_src.tree_draft(
+            hist, hlen, width, D, live, dst,
+            jax.random.fold_in(key, rounds + i), temps, top_k, top_p)
+        last = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        toks = jnp.concatenate(
+            [last[:, None], drafts.reshape(S, D * width)], axis=1)
+        W = cache.lengths
+        positions, mask = _tree_chunk_operands(width, nodes, W,
+                                               cache.max_seq)
+        logits, cache = fwd(params, cfg, toks, cache, active=live,
+                            use_kernel=use_kernel, positions=positions,
+                            attn_mask=mask)
+        emitted, n_acc, perm = speculative_tree_accept(
+            logits, drafts, jax.random.fold_in(key, i), temps,
+            top_k, top_p, spec_mask, qlog, width=width, nodes=nodes)
+        cand = (col <= n_acc[:, None]) & (col < rem[:, None])
+        stop_at = cand & has_stop[:, None] & (emitted == stops[:, None])
+        prior = jnp.cumsum(stop_at.astype(jnp.int32), axis=1) \
+            - stop_at.astype(jnp.int32)
+        valid = cand & (prior == 0) & live[:, None]
+        m = valid.sum(axis=1).astype(jnp.int32)
+        # compact the accepted path's K/V to base..base+m-1 (the
+        # verify wrote chunk-index order), then roll the +N advance
+        # back to W + m — the last emitted token is never written,
+        # decode-style
+        cache = cache._replace(lengths=W)
+        cache = permute_paged_tail(cache, perm, active=live)
+        cache = cache._replace(
+            lengths=jnp.where(live, W + m, W))
+        dst = _draft_rollback(dst, dlen0, live, m)
+        wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)
+        cur = jnp.take_along_axis(hist, wpos, axis=1)
+        hist = hist.at[rows, wpos].set(jnp.where(valid, emitted, cur))
+        hlen = jnp.where(live, hlen + m, hlen)
+        rem = jnp.where(live, rem - m, rem)
+        died = (valid & has_stop[:, None]
+                & (emitted == stops[:, None])).any(axis=1)
+        live = live & ~died & (rem > 0)
+        return (hist, hlen, cache, dst, live, rem), (emitted, valid)
+
+    (hist, hist_len, cache, dstate, _, rem), (toks_blk, valid_blk) = \
+        lax.scan(body, (hist, hist_len, cache, dstate, live0, budgets),
+                 jnp.arange(rounds, dtype=jnp.int32))
+    return toks_blk, valid_blk, hist, hist_len, rem, cache, dstate
+
+
+def _spec_tree_scan_win(cfg: ModelConfig, rounds: int, width: int,
+                        nodes: int, draft_src, params, hist, hist_len,
+                        cache: PagedKVCache, dstate, window: KVWindow,
+                        win_len, active, temps, stops, budgets,
+                        top_k: int, top_p: float, key, spec_mask,
+                        use_kernel: bool = False):
+    """Write-combined twin of _spec_tree_scan — the verify stages all N
+    tree nodes into the window at offset win_len (chunk-index order),
+    permute_window_tail compacts the accepted path to win_len..
+    win_len+m-1, and win_len advances by m only: rejected BRANCHES sit
+    past win_len exactly like the linear path's rejected drafts —
+    unattendable, never flushed into the pool, overwritten by the next
+    round's staging. This is the stronger rollback story of the two
+    (the pool never holds a rejected node), which is why tree K/V is
+    staged past the committed length in the write-combined window by
+    default. Absolute geometry: node 0 sits at flushed + staged length
+    (cache.lengths + win_len), so `j < base` in the tree mask covers
+    committed AND previously staged entries.
+    """
+    S, H = hist.shape
+    D = tree_depth(width, nodes)
+    C = D + 1
+    has_stop = stops >= 0
+    col = jnp.arange(C)[None, :]
+    rows = jnp.arange(S)[:, None]
+    last0 = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    live0 = active & (budgets > 0) \
+        & jnp.where(has_stop, last0 != stops, True)
+
+    def body(carry, i):
+        hist, hlen, win, wlen, dst, live, rem = carry
+        dlen0 = dst.length if dst is not None else None
+        drafts, qlog, dst = draft_src.tree_draft(
+            hist, hlen, width, D, live, dst,
+            jax.random.fold_in(key, rounds + i), temps, top_k, top_p)
+        last = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        toks = jnp.concatenate(
+            [last[:, None], drafts.reshape(S, D * width)], axis=1)
+        positions, mask = _tree_chunk_operands(
+            width, nodes, cache.lengths + wlen, cache.max_seq)
+        logits, win = paged_forward_window(params, cfg, toks, cache, win,
+                                           wlen, active=live,
+                                           use_kernel=use_kernel,
+                                           positions=positions,
+                                           attn_mask=mask)
+        emitted, n_acc, perm = speculative_tree_accept(
+            logits, drafts, jax.random.fold_in(key, i), temps,
+            top_k, top_p, spec_mask, qlog, width=width, nodes=nodes)
+        cand = (col <= n_acc[:, None]) & (col < rem[:, None])
+        stop_at = cand & has_stop[:, None] & (emitted == stops[:, None])
+        prior = jnp.cumsum(stop_at.astype(jnp.int32), axis=1) \
+            - stop_at.astype(jnp.int32)
+        valid = cand & (prior == 0) & live[:, None]
+        m = valid.sum(axis=1).astype(jnp.int32)
+        # compact the accepted path inside the window, then advance
+        # win_len by the kept count — the rollback
+        win = permute_window_tail(win, wlen, perm)
         wlen = jnp.where(live, wlen + m, wlen)
         dst = _draft_rollback(dst, dlen0, live, m)
         wpos = jnp.clip(hlen[:, None] + col, 0, H - 1)

@@ -233,3 +233,63 @@ class ModelDraftSource:
             cur = nxt
         return (jnp.stack(drafts, axis=1),
                 jnp.stack(qlogs, axis=1), state)
+
+    def tree_draft(self, hist, hlen, width: int, depth: int, live, state,
+                   key, temps, top_k: int, top_p: float):
+        """Token-TREE drafting (ISSUE 19): `depth` micro-steps along the
+        PRINCIPAL chain, branching a fan of `width` sibling candidates
+        from each step's per-position q. Same KV machinery and entry
+        invariant as `draft` (state.length == hlen - 1; micro-step d
+        writes the principal's K/V at the draft length; a final extra
+        step covers the all-accepted case) — the tree adds only extra
+        SAMPLES per step, never extra forwards, because all siblings at
+        a depth share the principal's context in the caterpillar
+        topology (sampling.tree_principal).
+
+        Per step the fan is drawn from ONE filtered scaled q: sibling 0
+        (the principal, which the chain continues through) plus
+        width-1 extra i.i.d. categorical draws on stochastic rows —
+        the i.i.d. property is what makes the recursive-residual
+        acceptance law exact — or the top-`width` distinct tokens on
+        greedy rows (index 0 = the raw argmax, so the greedy principal
+        chain is byte-identical to `draft`'s).
+
+        Only the principal's K/V enters the draft cache: when the
+        verify accepts a non-principal sibling as its DEEPEST node, the
+        rolled-back cache holds the principal's K/V at that one
+        position instead — bounded one-token context staleness for the
+        next round's drafting. Exactness is unaffected (the accept
+        test always scores the q the drafter actually sampled from);
+        only the hedge's future acceptance rate pays marginally.
+
+        Returns (drafts [S, depth, width] int32, q_logits
+        [S, depth, V] — one shared filtered scaled q per fan — and the
+        advanced state, length = base + depth + 1 where live)."""
+        S, H = hist.shape
+        dlen0 = state.length
+        cur = jnp.take_along_axis(
+            hist, jnp.clip(hlen - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        fans, qlogs = [], []
+        for d in range(depth + 1):
+            logits, state = forward(self.params, self.cfg, cur[:, None],
+                                    state)
+            state = state._replace(
+                length=jnp.where(live, dlen0 + d + 1, dlen0))
+            if d == depth:
+                break
+            q = logits[:, -1, :]
+            scaled = _filter_logits(q / safe_t, top_k, top_p)
+            _, top_toks = jax.lax.top_k(q, width)  # [S, width], [0]=argmax
+            fan = []
+            for i in range(width):
+                drawn = jax.random.categorical(
+                    jax.random.fold_in(key, d * width + i), scaled,
+                    axis=-1).astype(jnp.int32)
+                fan.append(jnp.where(temps > 0, drawn,
+                                     top_toks[:, i].astype(jnp.int32)))
+            fans.append(jnp.stack(fan, axis=1))
+            qlogs.append(scaled)
+            cur = fan[0]  # the chain continues through the principal
+        return (jnp.stack(fans, axis=1),
+                jnp.stack(qlogs, axis=1), state)

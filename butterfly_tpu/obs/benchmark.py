@@ -474,7 +474,12 @@ def run_spec_benchmark(model, params, *, n_requests: int = 8,
     little) at the same operating point, recording per-source
     `spec_accept_rate_{ngram,model}` and
     `spec_tokens_per_forward_{ngram,model}`. The acceptance criterion
-    is spec_accept_rate_model > spec_accept_rate_ngram."""
+    is spec_accept_rate_model > spec_accept_rate_ngram. A third row
+    ("tree", ISSUE 19) reruns the model draft as a width-2 token tree
+    at the SAME node budget (spec_tree_nodes = gamma+1), emitting
+    spec_{accept_rate,tokens_per_forward}_tree and
+    serving_spec_tree_tokens_per_sec — the equal-FLOPs tree-vs-linear
+    comparison."""
     import jax
     from butterfly_tpu.core.config import RuntimeConfig
     from butterfly_tpu.engine.serving import ServingEngine
@@ -561,9 +566,23 @@ def run_spec_benchmark(model, params, *, n_requests: int = 8,
                     max_new_lo=max(8, max_new // 4), max_new_hi=max_new)
     mixed_prompts = [s.tokens for s in wl.sample(n_requests, seed)]
     out["serving_spec_draft_layers"] = draft_layers
-    for src, extra in (("ngram", {}),
-                       ("model", {"draft_model": "model",
-                                  "draft_layers": draft_layers})):
+    # tree row (ISSUE 19): the same model draft source, same node
+    # budget per verify (N = gamma+1 nodes vs the linear chain's
+    # gamma+1 positions — equal verify FLOPs), but spent on a
+    # width-2 token tree. spec_tokens_per_forward_tree >
+    # spec_tokens_per_forward_model is the acceptance key: sibling
+    # hedging beats chain depth exactly where drafts are mediocre
+    # (this mixed_chat shape), which is why the tree row rides THIS
+    # sub-phase and not the draft-friendly self-continuation one.
+    rows = [("ngram", {}),
+            ("model", {"draft_model": "model",
+                       "draft_layers": draft_layers})]
+    if gamma % 2 == 0:  # width 2 needs (N-1) = gamma divisible by 2
+        rows.append(("tree", {"draft_model": "model",
+                              "draft_layers": draft_layers,
+                              "spec_tree_width": 2,
+                              "spec_tree_nodes": gamma + 1}))
+    for src, extra in rows:
         sched = build(rt_on.replace(**extra))
         for p in mixed_prompts[:min(len(mixed_prompts), max_batch)]:
             sched.submit(p, max_new_tokens=4)   # warm off the clock

@@ -126,6 +126,12 @@ def render_prometheus(values: Dict[str, float],
         if name in skip:
             continue
         full = f"{PREFIX}_{name}"
+        if isinstance(val, str):
+            # String-valued annotations (e.g. spec_mixed_fallback_reason)
+            # ride along as comments: the exposition format has no string
+            # samples, and parsers ignore non-HELP/TYPE comment lines.
+            lines.append(f"# {full}: {val}")
+            continue
         if name in HELP:
             lines.append(f"# HELP {full} {HELP[name]}")
             kind = "counter" if name in COUNTERS else "gauge"

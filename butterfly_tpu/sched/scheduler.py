@@ -197,6 +197,14 @@ class Scheduler:
         # reseed hook needs the barrier this mode deletes).
         self._mixed_mode = (rt.scheduler == "continuous"
                             and engine.mixed_dispatch_ready)
+        # visibility (ISSUE 19 satellite): mixed dispatch was ASKED
+        # for but the engine gated it back to the alternating path
+        # (stateful draft source, or tree speculation — neither has a
+        # fused mixed program). PR 18 made that fallback silent; the
+        # reason string rides metrics() and the counter below makes
+        # the gating countable in any scrape.
+        self._mixed_fallback_reason = engine.mixed_fallback_reason \
+            if rt.scheduler == "continuous" else None
         # per-step chunk width C: under spec the verify shape pins it
         # to gamma+1; otherwise the inline budget (clamped by the tick
         # chunk budget) IS the width — one prefilling slot chews C
@@ -340,6 +348,15 @@ class Scheduler:
             "drafts + corrections/bonus samples); divided by "
             "spec_forwards_total this is tokens/forward — the number "
             "speculation exists to push past 1")
+        self._c_spec_mixed_fb = reg.counter(
+            "spec_mixed_fallback_total",
+            "Mixed dispatch requested but gated back to the "
+            "alternating path at engine construction (stateful draft "
+            "source needs the admission barrier; tree speculation has "
+            "no fused mixed program) — nonzero means the "
+            "mixed_dispatch flag is silently not in effect")
+        if self._mixed_fallback_reason is not None:
+            self._c_spec_mixed_fb.inc()
         self._h_accept = reg.histogram(
             "spec_accept_rate",
             "Per-slot-round draft acceptance fraction (accepted / "
@@ -839,12 +856,23 @@ class Scheduler:
         # _ensure_or_preempt falls back to a drain barrier before it
         # ever preempts. A spec verify's trailing writes past the
         # lifetime clamp land on the null page via the table default.
-        step = k * (rt.speculative_gamma + 1) if spec else k
-        horizon = (len(self._inflight) + 1) * step + 1
+        step = k * self.engine.spec_emit_width if spec else k
+        # tree mode (ISSUE 19): a round verifies N nodes but commits at
+        # most D+1 = spec_emit_width tokens, and the accepted path is
+        # COMPACTED from chunk positions as deep as base + N - 1 — the
+        # accepted sources must sit on real pages (only the rejected
+        # remainder may land on the null page), so both the horizon
+        # and the lifetime clamp carry the N - (D+1) overhang
+        tree_slack = 0
+        if spec and self.engine.spec_tree_mode:
+            tree_slack = (self.engine.spec_tree_geometry[1]
+                          - self.engine.spec_emit_width)
+        horizon = (len(self._inflight) + 1) * step + tree_slack + 1
         for req in list(self.running):
             if req in self.running:
                 need = min(len(req.all_tokens) + horizon,
-                           len(req.prompt) + req.max_new_tokens)
+                           len(req.prompt) + req.max_new_tokens
+                           + tree_slack)
                 self._ensure_or_preempt(req, need)
         if mixed and self._prefill_group:
             # prefill lanes advance up to C tokens per scan step, so
@@ -1002,6 +1030,12 @@ class Scheduler:
             h = self._h_accept
             m["spec_accept_rate"] = \
                 h._sum / h._count if h._count else 0.0
+        m["spec_mixed_fallback_total"] = self._c_spec_mixed_fb.value
+        if self._mixed_fallback_reason is not None:
+            # the one-line why (ISSUE 19 satellite): which engine gate
+            # sent a requested mixed_dispatch back to the alternating
+            # path — the only non-float value in this dict
+            m["spec_mixed_fallback_reason"] = self._mixed_fallback_reason
         m["queue_depth"] = len(self.waiting)
         m["active_requests"] = len(self._all_live)
         m["kv_pages_free"] = self.alloc.free_pages
@@ -1825,7 +1859,7 @@ class Scheduler:
                 self._c_kv_flushed.inc(int(flushed))
             return False
         finished_before = self._c_finished.value
-        C = self.engine.runtime.speculative_gamma + 1
+        C = self.engine.spec_emit_width
         parts = [f[3].reshape(1) for f in firsts]
         for ent in blocks:
             if ent[0] == "decode":
@@ -1951,7 +1985,10 @@ class Scheduler:
         instruments (a round's emissions are 1 correction/bonus plus
         `count-1` accepted drafts)."""
         R = toks3.shape[0]
-        gamma = self.engine.runtime.speculative_gamma
+        # per-round acceptance ceiling: gamma accepted drafts for the
+        # linear chain, tree depth D = emit_width - 1 for tree mode
+        # (the root->leaf walk accepts at most one node per depth)
+        denom = self.engine.spec_emit_width - 1
         # verify forwards that did work: rounds with ANY valid emission
         # (trailing all-dead rounds in a block ran but verified nothing)
         self._c_spec_fwd.inc(int(np.any(valid3, axis=(1, 2)).sum()))
@@ -1979,8 +2016,8 @@ class Scheduler:
                 if cnt and not first_round:
                     self._c_spec_tok.inc(cnt)
                     self._c_spec_acc.inc(max(0, cnt - 1))
-                    if req.speculative and gamma > 0:
-                        self._h_accept.observe((cnt - 1) / gamma)
+                    if req.speculative and denom > 0:
+                        self._h_accept.observe((cnt - 1) / denom)
                 if req.done:
                     break
 

@@ -153,6 +153,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "narrow HF-format draft checkpoint (same "
                         "vocabulary as the target — validated) instead "
                         "of deriving by truncation")
+    s.add_argument("--spec-tree", type=int, default=0, metavar="WIDTH",
+                   help="token-TREE speculation (SpecInfer-style, "
+                        "ISSUE 19): each draft expansion step branches "
+                        "the top-WIDTH children and one forward "
+                        "verifies the whole tree under a tree-attention "
+                        "mask, so sibling branches hedge the draft's "
+                        "uncertainty at the same verify FLOPs. "
+                        "Requires --draft-source model; greedy output "
+                        "stays byte-identical to plain decode and "
+                        "sampled output stays distribution-exact "
+                        "(recursive-residual acceptance). 0/1 = linear "
+                        "chain (default)")
+    s.add_argument("--spec-tree-nodes", type=int, default=0, metavar="N",
+                   help="total tree node budget per verify, INCLUDING "
+                        "the root chain token; (N-1) must divide by "
+                        "--spec-tree. 0 = auto GAMMA+1, which holds "
+                        "verify FLOPs equal to the linear chain")
     def positive_int(v):
         n = int(v)
         if n < 1:

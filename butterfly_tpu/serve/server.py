@@ -1238,6 +1238,8 @@ def run_server(args) -> int:
                        draft_model=getattr(args, "draft_source", "ngram"),
                        draft_layers=getattr(args, "draft_layers", 0),
                        draft_ckpt=getattr(args, "draft_ckpt", None),
+                       spec_tree_width=getattr(args, "spec_tree", 0),
+                       spec_tree_nodes=getattr(args, "spec_tree_nodes", 0),
                        decode_steps_per_tick=getattr(
                            args, "decode_steps_per_tick", 1),
                        prefill_max_batch=getattr(

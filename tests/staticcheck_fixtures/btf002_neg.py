@@ -127,3 +127,33 @@ class MixedEngine:
             params, toks, self._cursor, self.cache, self._pbuf)
         self._cursor, self.cache = cursor, cache
         return blk, self._cursor, self._pbuf  # all rebound / non-donated
+
+
+def _step_tree(params, hist, cache, dstate, window, wlen):
+    return hist, hist, cache, dstate, window, wlen
+
+
+class TreeEngine:
+    """Blessed tree-carry pattern (ISSUE 19): history + cache + draft
+    KV state + staged tree-KV window + count ALL rebind from the
+    result before any later read (serving.py spec_block_async, tree
+    windowed path)."""
+
+    def __init__(self):
+        self._tree_progs = {}
+
+    def _tree_prog(self, r):
+        prog = self._tree_progs.get(r)
+        if prog is None:
+            prog = jax.jit(_step_tree, donate_argnums=(1, 3, 4, 5))
+            self._tree_progs[r] = prog
+        return prog
+
+    def tree_dispatch(self, params, r):
+        toks, hist, cache, dstate, window, wlen = self._tree_prog(r)(
+            params, self._hist, self.cache, self._draft_state,
+            self._window, self._wlen)
+        self._hist, self.cache = hist, cache
+        self._draft_state, self._window, self._wlen = \
+            dstate, window, wlen
+        return toks, self._window.width  # all rebound: clean reads

@@ -1,6 +1,6 @@
 """BTF002 positive fixture: reads of donated references after dispatch.
 
-Expected findings: 6 —
+Expected findings: 7 —
 * a read of the donated cache in the statement after the dispatch,
 * the same handle re-passed on the next loop iteration without rebind,
 * a read of a tree donated to a locally-built donating jit,
@@ -12,7 +12,11 @@ Expected findings: 6 —
   history but reads the donated draft cache afterwards,
 * a mixed-dispatch block (ISSUE 18: factory program donating the
   per-slot prefill chunk-offset cursor alongside the cache) that
-  rebinds the cache but reads the stale cursor afterwards.
+  rebinds the cache but reads the stale cursor afterwards,
+* a tree-speculation dispatch (ISSUE 19: factory program donating the
+  history carry, the draft KV state, AND the staged tree-KV window +
+  count) that rebinds everything except the window and then reads the
+  stale tree K/V.
 """
 import jax
 
@@ -122,3 +126,33 @@ class MixedEngine:
             params, toks, self._cursor, self.cache, self._pbuf)
         self.cache = cache          # cache rebound...
         return blk, self._cursor    # finding 6: cursor NOT rebound
+
+
+def _step_tree(params, hist, cache, dstate, window, wlen):
+    return hist, hist, cache, dstate, window, wlen
+
+
+class TreeEngine:
+    """The tree-speculation window carry (ISSUE 19): one program
+    donates the history carry, the draft KV state, AND the staged
+    tree-KV window + count (serving.py's _spec_tree_win_prog shape —
+    rejected branches live only in the window, so a stale window read
+    is a read of freed tree K/V)."""
+
+    def __init__(self):
+        self._tree_progs = {}
+
+    def _tree_prog(self, r):
+        prog = self._tree_progs.get(r)
+        if prog is None:
+            prog = jax.jit(_step_tree, donate_argnums=(1, 3, 4, 5))
+            self._tree_progs[r] = prog
+        return prog
+
+    def stale_tree_window_read(self, params, r):
+        toks, hist, cache, dstate, window, wlen = self._tree_prog(r)(
+            params, self._hist, self.cache, self._draft_state,
+            self._window, self._wlen)
+        self._hist, self.cache = hist, cache
+        self._draft_state, self._wlen = dstate, wlen
+        return toks, self._window   # finding 7: tree window NOT rebound

@@ -124,6 +124,23 @@ def test_render_prometheus_plain_dict_unchanged():
     assert "butterfly_tokens_generated_total 5" in text
 
 
+def test_render_prometheus_string_annotation_becomes_comment():
+    """String-valued metrics() entries (spec_mixed_fallback_reason) must
+    not crash the exposition renderer — they ride as comment lines the
+    text-format parsers (including parse_prometheus) ignore."""
+    text = render_prometheus({
+        "spec_mixed_fallback_total": 1.0,
+        "spec_mixed_fallback_reason": "tree speculation has no "
+                                      "fused mixed program",
+    })
+    assert "butterfly_spec_mixed_fallback_total 1" in text
+    assert "# butterfly_spec_mixed_fallback_reason: tree speculation" \
+        in text
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            float(line.rsplit(None, 1)[1])  # every sample parses
+
+
 # -- tracer -----------------------------------------------------------------
 
 def test_tracer_timeline_roundtrip():

@@ -1303,3 +1303,104 @@ def test_flight_recorder_preempt_storm_dump_on_scheduler():
     assert art["signals"]["preemptions_total"] >= 1
     import json as _json
     _json.dumps(art)  # artifact must be JSON-serializable
+
+
+# -- token-tree speculation (ISSUE 19) --------------------------------------
+
+
+def test_tree_speculative_parity_grid():
+    """Acceptance criterion: greedy TREE speculation (width-2, node
+    budget gamma+1 — equal verify FLOPs vs the linear chain) is
+    byte-identical to spec-off greedy serving across rounds-per-tick x
+    dispatch-ahead depth x KV-window on/off. Every emitted token lies
+    on the realized argmax path, the tree-attention mask keeps sibling
+    branches invisible to each other, and the accepted-path KV
+    compaction leaves the cache indistinguishable from plain decode —
+    any cross-branch leak or mis-permuted K/V diverges within a few
+    tokens (tools/mutcheck.py mutates exactly that mask against this
+    grid). max_new=11 lands mid-round, covering budget-tail clamping."""
+    prompts = [[5, 7, 11], [3, 3, 3, 3, 3], [2], list(range(1, 9))]
+    ref, _ = make_sched(max_batch=4, max_seq=64)
+    want = [ref.submit(p, max_new_tokens=11) for p in prompts]
+    ref.run_until_done()
+    for k in (1, 4):
+        for depth in (1, 2):
+            for wc in (False, True):
+                sched, _ = make_sched(max_batch=4, max_seq=64,
+                                      speculative_gamma=4,
+                                      draft_model="model",
+                                      draft_layers=1,
+                                      spec_tree_width=2,
+                                      kv_write_combine=wc,
+                                      decode_steps_per_tick=k,
+                                      inflight_blocks=depth)
+                assert sched.engine.spec_tree_mode
+                assert sched.engine.spec_tree_geometry == (2, 5)
+                got = [sched.submit(p, max_new_tokens=11) for p in prompts]
+                sched.run_until_done()
+                assert [r.output for r in got] == \
+                    [r.output for r in want], (k, depth, wc)
+
+
+def test_tree_speculative_opt_out_and_stop_token():
+    """Tree-mode slotmates: a speculative=False request rides the tree
+    block but emits exact plain-decode tokens (one per round), and a
+    stop token truncates a tree emission mid-path without leaking
+    post-stop tokens."""
+    ref, params = make_sched(max_batch=2, max_seq=64)
+    base = ref.submit([5, 7, 11], max_new_tokens=12)
+    ref.run_until_done()
+    stop = base.output[6]
+    ref2, _ = make_sched(max_batch=2, max_seq=64)
+    want = ref2.submit([5, 7, 11], max_new_tokens=12, stop_token=stop)
+    ref2.run_until_done()
+    sched, _ = make_sched(max_batch=2, max_seq=64, speculative_gamma=4,
+                          draft_model="model", draft_layers=1,
+                          spec_tree_width=2)
+    r1 = sched.submit([5, 7, 11], max_new_tokens=12, stop_token=stop)
+    r2 = sched.submit([3, 1], max_new_tokens=8, speculative=False)
+    sched.run_until_done()
+    assert r1.output == want.output
+    assert r2.output == ref_tokens(params, [3, 1], 8)
+
+
+def test_tree_geometry_validation():
+    """Bad tree geometry fails LOUDLY at engine construction: (N-1)
+    not divisible by width, node budget below one full fan, and a
+    draft source without tree_draft (ngram) are all rejected."""
+    import pytest
+    model = Model(CFG)
+    params = model.init(jax.random.PRNGKey(42))
+
+    def build(**kw):
+        rt = RuntimeConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                           **kw)
+        return ServingEngine(model, params, rt)
+
+    with pytest.raises(ValueError, match="divisible"):
+        build(speculative_gamma=3, draft_model="model", draft_layers=1,
+              spec_tree_width=2)  # N = 4 -> (N-1) % 2 != 0
+    with pytest.raises(ValueError, match="invalid for width"):
+        build(speculative_gamma=4, draft_model="model", draft_layers=1,
+              spec_tree_width=2, spec_tree_nodes=2)
+    with pytest.raises(ValueError, match="tree_draft"):
+        build(speculative_gamma=4, spec_tree_width=2)  # ngram source
+
+
+def test_mixed_fallback_counter_and_reason():
+    """ISSUE 19 satellite: mixed_dispatch requested but gated (tree
+    mode has no fused mixed program; stateful draft sources need the
+    admission barrier) increments spec_mixed_fallback_total and
+    surfaces the one-line reason in metrics(); an eligible config
+    reports 0 and no reason."""
+    sched, _ = make_sched(max_batch=2, speculative_gamma=4,
+                          draft_model="model", draft_layers=1,
+                          spec_tree_width=2, mixed_dispatch=True)
+    m = sched.metrics()
+    assert m["spec_mixed_fallback_total"] == 1.0
+    assert "spec_mixed_fallback_reason" in m
+    sched2, _ = make_sched(max_batch=2, speculative_gamma=3,
+                           mixed_dispatch=True)
+    m2 = sched2.metrics()
+    assert m2["spec_mixed_fallback_total"] == 0.0
+    assert "spec_mixed_fallback_reason" not in m2

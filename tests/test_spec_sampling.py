@@ -242,3 +242,162 @@ def test_opt_out_rows_sample_full_distribution():
     emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
     tgt = _target(logits[0, 0], 0.7)
     assert np.abs(emp - tgt).max() < 0.015
+
+
+# -- token-tree acceptance (ISSUE 19: speculative_tree_accept) --------------
+#
+# The recursive-residual law for a width-w fan of i.i.d. candidates
+# from one proposal q: r_0 = p, accept candidate j w.p.
+# min(1, r_j(x)/q(x)), on rejection r_{j+1} = norm((r_j - q)+). Exact
+# for ANY q, like the chain law above — these tests pin it on the
+# kernel the tree spec scan emits through.
+
+from butterfly_tpu.engine.sampling import (  # noqa: E402
+    speculative_tree_accept, tree_node_index)
+
+TREE_W, TREE_N = 2, 5          # width-2, 5 nodes -> depth D = 2
+TREE_D = (TREE_N - 1) // TREE_W
+
+
+def _draw_tree(logits, q_logits, temps, n, top_k=0, top_p=1.0, seed=0,
+               spec_mask=None):
+    """Tree harness: per trial each depth's fan is w i.i.d. draws from
+    the (scaled, filtered) shared q — exactly what tree_draft does on
+    stochastic rows — then scored by speculative_tree_accept with the
+    same q_logits. logits [S, N, V] plays the tree-verify node batch."""
+    S = np.asarray(logits).shape[0]
+    V = np.asarray(logits).shape[-1]
+    scaled_q = _filter_logits(
+        jnp.asarray(q_logits)
+        / jnp.asarray(temps, jnp.float32)[:, None, None], top_k, top_p)
+    fan_q = jnp.broadcast_to(scaled_q[:, :, None, :],
+                             (S, TREE_D, TREE_W, V))
+
+    def one(k):
+        kd, ka = jax.random.split(k)
+        drafts = jax.random.categorical(kd, fan_q,
+                                        axis=-1).astype(jnp.int32)
+        em, na, perm = speculative_tree_accept(
+            jnp.asarray(logits), drafts, ka,
+            jnp.asarray(temps, jnp.float32), top_k, top_p,
+            spec_mask if spec_mask is None else jnp.asarray(spec_mask),
+            scaled_q, width=TREE_W, nodes=TREE_N)
+        return em, na, perm
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    em, na, perm = jax.jit(jax.vmap(one))(keys)
+    return np.asarray(em), np.asarray(na), np.asarray(perm)
+
+
+def test_tree_first_token_marginal_matches_target():
+    """P(emitted[0] = x) = p_0(x) under an ARBITRARY tree proposal:
+    accepted-sibling mass + every residual-resample branch reassemble
+    the target exactly (the recursive-residual law, depth 1)."""
+    rng = np.random.RandomState(20)
+    logits = rng.randn(1, TREE_N, V).astype(np.float32) * 2.0
+    q_logits = rng.randn(1, TREE_D, V).astype(np.float32) * 2.0
+    em, _, _ = _draw_tree(logits, q_logits, [0.7], 20000)
+    emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
+    tgt = _target(logits[0, 0], 0.7)
+    assert np.abs(emp - tgt).max() < 0.015, (emp, tgt)
+
+
+def test_tree_acceptance_mass_recursive_residual():
+    """P(n_acc >= 1) = 1 - prod_j (1 - beta_j) with beta_j the j-th
+    sibling's conditional accept mass sum_x q(x) min(1, r_j(x)/q(x))
+    under the recursive residual r_0 = p, r_{j+1} = norm((r_j - q)+).
+    beta_0 alone is the ISSUE's 'sum over root children of
+    q*min(1, p/q)' — the closed form the product reduces to at w=1."""
+    rng = np.random.RandomState(21)
+    logits = rng.randn(1, TREE_N, V).astype(np.float32) * 2.0
+    q_logits = rng.randn(1, TREE_D, V).astype(np.float32) * 2.0
+    _, na, _ = _draw_tree(logits, q_logits, [1.0], 20000, seed=3)
+    p = _target(logits[0, 0], 1.0).astype(np.float64)
+    q = _target(q_logits[0, 0], 1.0).astype(np.float64)
+    r = p.copy()
+    miss = 1.0
+    for _ in range(TREE_W):
+        beta = float(np.sum(q * np.minimum(1.0, r / np.maximum(q, 1e-30))))
+        miss *= 1.0 - beta
+        r_next = np.maximum(r - q, 0.0)
+        if r_next.sum() > 0:
+            r = r_next / r_next.sum()
+    want = 1.0 - miss
+    assert abs((na[:, 0] >= 1).mean() - want) < 0.015, want
+
+
+def test_tree_depth2_conditional_matches_target():
+    """Given the depth-1 PRINCIPAL accepted, emitted[1] must be
+    distributed as the target at the principal node — the walk's
+    conditional law equals autoregressive sampling along the realized
+    path."""
+    rng = np.random.RandomState(22)
+    logits = rng.randn(1, TREE_N, V).astype(np.float32) * 2.0
+    # proposal near the target: plenty of principal-accept mass
+    q_logits = np.stack(
+        [logits[0, [tree_node_index(d + 1, 0, TREE_W) - 1 if False else 0][0]]
+         for d in range(TREE_D)])[None] * 0.0
+    q_logits = logits[:, :1, :].repeat(TREE_D, axis=1) \
+        + rng.randn(1, TREE_D, V).astype(np.float32) * 0.3
+    em, na, perm = _draw_tree(logits, q_logits, [0.8], 30000, seed=4)
+    pn1 = tree_node_index(1, 0, TREE_W)  # depth-1 principal chunk index
+    sel = (na[:, 0] >= 1) & (perm[:, 0, 1] == pn1)
+    assert sel.sum() > 5000
+    emp = np.bincount(em[sel, 0, 1], minlength=V) / sel.sum()
+    tgt = _target(logits[0, pn1], 0.8)
+    assert np.abs(emp - tgt).max() < 0.02
+
+
+def test_tree_greedy_matches_host_walk():
+    """temp-0 rows: the device walk must equal a host reference that
+    greedily walks the caterpillar — first sibling matching the
+    parent's argmax is accepted, non-principal accepts terminate, and
+    the final token is the argmax at the terminal node. This is the
+    kernel half of the serving byte-parity contract."""
+    from butterfly_tpu.engine.sampling import tree_principal
+    rng = np.random.RandomState(23)
+    for trial in range(20):
+        logits = rng.randn(1, TREE_N, V).astype(np.float32) * 2.0
+        drafts = rng.randint(0, V, (1, TREE_D, TREE_W))
+        em, na, perm = speculative_tree_accept(
+            jnp.asarray(logits), jnp.asarray(drafts, jnp.int32),
+            jax.random.PRNGKey(trial), jnp.asarray([0.0], jnp.float32),
+            0, 1.0, width=TREE_W, nodes=TREE_N)
+        greedy = np.argmax(logits[0], axis=-1)
+        want, want_perm = [], [0]
+        parent = 0
+        for d in range(1, TREE_D + 1):
+            hit = None
+            for j in range(TREE_W):
+                if drafts[0, d - 1, j] == greedy[parent]:
+                    hit = j
+                    break
+            if hit is None:
+                want.append(int(greedy[parent]))
+                break
+            node = tree_node_index(d, hit, TREE_W)
+            want.append(int(drafts[0, d - 1, hit]))
+            want_perm.append(node)
+            if hit != 0 or d == TREE_D:
+                want.append(int(greedy[node]))
+                break
+            parent = node
+        n = int(np.asarray(na)[0])
+        assert n == len(want) - 1, trial
+        assert np.asarray(em)[0, :n + 1].tolist() == want, trial
+        assert np.asarray(perm)[0, :n + 1].tolist() == want_perm, trial
+
+
+def test_tree_opt_out_rows_sample_full_distribution():
+    """spec_mask=False rows under the tree kernel: one token from the
+    FULL filtered target at node 0 — no accept test, no residual or
+    sibling-exclusion bias, n_acc identically 0."""
+    rng = np.random.RandomState(24)
+    logits = rng.randn(1, TREE_N, V).astype(np.float32) * 2.0
+    q_logits = rng.randn(1, TREE_D, V).astype(np.float32) * 2.0
+    em, na, _ = _draw_tree(logits, q_logits, [0.7], 20000, seed=5,
+                           spec_mask=np.asarray([False]))
+    assert (na == 0).all()
+    emp = np.bincount(em[:, 0, 0], minlength=V) / len(em)
+    tgt = _target(logits[0, 0], 0.7)
+    assert np.abs(emp - tgt).max() < 0.015

@@ -54,7 +54,7 @@ EXPECTED_RULES = {
 #: SOME sites — the mutcheck analyzer mutants — fails loudly.
 POSITIVE_COUNTS = {
     "BTF001": 4,
-    "BTF002": 6,
+    "BTF002": 7,
     "BTF003": 9,
     "BTF004": 7,
     "BTF005": 7,

@@ -158,6 +158,17 @@ MUTANTS = [
      "cache = cache._replace(lengths=jnp.where(live, W + m, W))",
      "cache = cache._replace(lengths=jnp.where(live, W + m + 1, W))",
      ["tests/test_sched.py"], {}),
+    # tree speculation (ISSUE 19): collapse the tree-attention
+    # ancestor mask to all-ones — every node attends EVERY chunk
+    # position in range, so sibling branches leak into each other's
+    # scores (a depth-2 node sees its parent's rejected sibling). The
+    # realized greedy path's logits shift and the tree parity grid
+    # (test_sched k x inflight x window, byte-identical vs spec-off)
+    # diverges within a few tokens.
+    ("butterfly_tpu/engine/serving.py",
+     "& jnp.transpose(tree_bits, (1, 0, 2))",
+     "& True",
+     ["tests/test_sched.py"], {}),
     # write-combined KV window (ISSUE 12): drop the flush's K-pool
     # scatter — staged K bytes never land, so after a drain the pool
     # serves zeros for flushed positions. Killed by the int8

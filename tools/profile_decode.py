@@ -24,12 +24,18 @@ the blocks back-to-back (no bubble) while the host sits in between.
 `--spec` traces one batched SPECULATIVE block (ISSUE 9): k rounds of
 draft + [S, gamma+1] multi-slot verify + on-device accept as one
 jitted scan (engine._spec_scan) — the speculative twin of --serving.
+Add `--tree-width w [--tree-nodes N]` (ISSUE 19) to trace the token-
+TREE variant instead (engine._spec_tree_scan: [S, N] single-dispatch
+tree verify under the tree-attention mask) — the TPU tree point is
+this flag flip.
 
 Usage: python tools/profile_decode.py [--max-new N] [--out DIR]
        python tools/profile_decode.py --serving [--steps-per-tick K]
        python tools/profile_decode.py --prefill [--prefill-max-batch B]
        python tools/profile_decode.py --pipeline [--steps-per-tick K]
        python tools/profile_decode.py --spec [--gamma G]
+       python tools/profile_decode.py --spec --draft-source model \
+           --tree-width 2 [--tree-nodes N]
 """
 from __future__ import annotations
 
@@ -90,6 +96,18 @@ def main() -> int:
                          "(its per-round micro-steps land inside the "
                          "traced scan) — the ROADMAP item 3 TPU "
                          "speedup point is this flag flip")
+    ap.add_argument("--tree-width", type=int, default=0,
+                    help="token-TREE speculation for --spec (matches "
+                         "RuntimeConfig.spec_tree_width, ISSUE 19): "
+                         "branch top-WIDTH children per draft expansion "
+                         "and verify the whole tree in one forward — "
+                         "the TPU tree trace is this flag flip. "
+                         "Requires --draft-source model; 0 = linear")
+    ap.add_argument("--tree-nodes", type=int, default=0,
+                    help="tree node budget N for --tree-width (matches "
+                         "RuntimeConfig.spec_tree_nodes; 0 = auto "
+                         "gamma+1, equal verify FLOPs vs the linear "
+                         "chain)")
     ap.add_argument("--draft-layers", type=int, default=0,
                     help="truncation depth for --draft-source model "
                          "(matches RuntimeConfig.draft_layers; 0 = "
@@ -259,6 +277,8 @@ def _profile_spec_block(args, model, params, kv_quant: str) -> int:
                        speculative_gamma=gamma,
                        draft_model=args.draft_source,
                        draft_layers=args.draft_layers,
+                       spec_tree_width=getattr(args, "tree_width", 0),
+                       spec_tree_nodes=getattr(args, "tree_nodes", 0),
                        prefill_chunk=max(512, args.prompt_len * args.batch))
     rng = np.random.RandomState(0)
     # harvest greedy continuations with a plain scheduler so the traced
@@ -285,11 +305,16 @@ def _profile_spec_block(args, model, params, kv_quant: str) -> int:
     sched._drain_inflight()
     # replicate tick()'s page preallocation so the traced block pays no
     # host-side growth, then capture exactly one fused spec dispatch
-    step = k * (gamma + 1)
+    # (tree mode: emit width D+1 per round plus the N-(D+1) compaction
+    # overhang — same arithmetic as Scheduler.tick)
+    step = k * engine.spec_emit_width
+    slack = 0
+    if engine.spec_tree_mode:
+        slack = engine.spec_tree_geometry[1] - engine.spec_emit_width
     for req in list(sched.running):
         if req in sched.running:
-            need = min(len(req.all_tokens) + step + 1,
-                       len(req.prompt) + req.max_new_tokens)
+            need = min(len(req.all_tokens) + step + slack + 1,
+                       len(req.prompt) + req.max_new_tokens + slack)
             sched._ensure_or_preempt(req, need)
     jax.block_until_ready(engine.cache.lengths)
     logdir = args.out or tempfile.mkdtemp(prefix="spec_block_trace_")

@@ -36,7 +36,11 @@ PHASE_NOTES = {
     "drain_barrier": "FULL drain (membership change forced it)",
     "admit": "admission: slot grant + prompt staging",
     "assemble": "per-tick operand assembly for the batch",
-    "dispatch": "alternating-path prefill/decode dispatch",
+    "dispatch": "alternating-path prefill/decode dispatch (seeing "
+                "this with mixed_dispatch requested = the engine "
+                "gated mixed off — stateful draft source or tree "
+                "speculation; spec_mixed_fallback_total counts it "
+                "and metrics() carries the reason line)",
     "mixed": "ONE fused dispatch: prefill chunks + decode/spec "
              "blocks together (mixed_dispatch, the default)",
     "spec_emit": "host accept/emit walk over drafted tokens",
