@@ -25,8 +25,18 @@ under staggered arrivals — serving tokens/sec/chip and TTFT/ITL
 percentiles, the BASELINE.md metrics of record.
 """
 import json
+import os
 import sys
 from pathlib import Path
+
+# The long-context phase needs a seq-parallel mesh; on the CPU smoke
+# that means 8 fake host devices (the tests/conftest.py arrangement).
+# Harmless on TPU: the flag only shapes the host CPU platform, and the
+# TPU backend's devices are what jax.devices() returns there.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 BASELINE_FILE = Path(__file__).parent / "bench_baseline.json"
 
@@ -59,6 +69,7 @@ def main() -> int:
                                              run_chaos_benchmark,
                                              run_decode_benchmark,
                                              run_fleet_benchmark,
+                                             run_longctx_benchmark,
                                              run_mixed_benchmark,
                                              run_serving_benchmark,
                                              run_spec_benchmark,
@@ -170,6 +181,18 @@ def main() -> int:
     serving.update(run_warm_prefill_benchmark(
         model, params, kv_quant=kv_quant, prompt_len=640,
         prefill_chunk=256, n_requests=6, max_batch=4))
+    # Long-context phase (ISSUE 20): one prompt spanning >= 8 prefill
+    # chunks admitted through the scheduler's seq-parallel lane
+    # (chunked SP prefill -> paged decode), beside short decoders. The
+    # acceptance pair: longctx_mixed_itl_p95 vs the alone p95 + the
+    # declared one-SP-chunk budget (longctx_itl_within_budget), plus
+    # the ring-vs-jnp microbench pair with its CPU honesty key
+    # (longctx_ring_kernelized: false — the Pallas leg is covered by
+    # the interpret-mode parity grid, not by this wall clock).
+    longctx_kw = (dict(prompt_len=4096, prefill_chunk=512, max_new=16,
+                       decode_new=64, kv_quant="int8")
+                  if on_tpu else dict())
+    serving.update(run_longctx_benchmark(model, params, **longctx_kw))
     # The spec phase also drafts with BOTH sources (ngram vs the real
     # on-device draft model, ISSUE 14) on mixed_chat-shaped prompts at
     # the same operating point: spec_accept_rate_model >

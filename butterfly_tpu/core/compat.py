@@ -1,0 +1,72 @@
+"""jax API compatibility shims (0.4.x <-> 0.6+).
+
+The seq-parallel paths (parallel/sequence.py, the engines' mesh
+contexts, partition.compiled_hlo) were written against the modern
+`jax.shard_map` / `jax.set_mesh` surface; the pinned toolchain ships
+jax 0.4.37 where both live under different names with slightly
+different signatures. Everything mesh-scoped funnels through these two
+helpers so the version fork exists in exactly one place:
+
+* `shard_map(f, mesh, in_specs, out_specs, axis_names)` — manual over
+  `axis_names` only; other mesh axes stay GSPMD-auto inside the body
+  (SP composes with TP). New jax spells that `axis_names=... ,
+  check_vma=False`; 0.4.x spells it `auto=<the other axes>,
+  check_rep=False` (the SNIPPETS.md kernel-wrapping pattern).
+* `mesh_ctx(mesh)` — `with` context making `mesh` ambient for jit
+  dispatch: `jax.set_mesh` where it exists, else the Mesh object
+  itself (a context manager on 0.4.x).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
+    """Version-portable shard_map over an explicit mesh.
+
+    `axis_names`: the mesh axes the body is MANUAL over (collectives
+    may reference them); None = manual over every axis of the mesh.
+    Replication of outputs is never checked/inferred (check_vma /
+    check_rep False) — out_specs are trusted, as everywhere else in
+    this codebase.
+    """
+    if hasattr(jax, "shard_map"):  # jax >= 0.6
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False, **kw)
+    # jax 0.4.x: go FULL manual. The `auto=` partial-manual form exists
+    # but its axis_index lowers to a bare partition-id the SPMD
+    # partitioner then refuses ("PartitionId instruction is not
+    # supported for SPMD partitioning"). Full manual sidesteps the
+    # partitioner entirely; axes the caller left auto just see their
+    # operands replicated per in_specs — correct, merely unsharded on
+    # the old toolchain (the new-API branch keeps them GSPMD-auto).
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis, from inside shard_map.
+
+    `lax.axis_size` is jax >= 0.5; on 0.4.x `psum(1, axis)` constant-
+    folds to the same static int.
+    """
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def mesh_ctx(mesh):
+    """Context manager making `mesh` the ambient mesh (None = no-op)."""
+    if mesh is None:
+        return contextlib.nullcontext()
+    if hasattr(jax, "set_mesh"):  # jax >= 0.6
+        return jax.set_mesh(mesh)
+    return mesh  # Mesh is a context manager on 0.4.x
+

@@ -217,6 +217,27 @@ class RuntimeConfig:
                                       # (budget // C), trading admission
                                       # throughput against decode-slot
                                       # step latency
+    seq_parallel_threshold: int = 0   # long-prompt admission lane: a
+                                      # waiting prompt LONGER than this
+                                      # routes its prefill through
+                                      # chunked seq-parallel dispatches
+                                      # (ring attention over the mesh's
+                                      # seq axis, engine.sp_prefill_chunk)
+                                      # whose K/V lands in the ordinary
+                                      # page pool — prefix-registry-
+                                      # visible, evictable, exportable —
+                                      # then decodes as a normal paged
+                                      # slot. 0 = off (every prompt
+                                      # takes the single-device chunk
+                                      # path). Needs a mesh with seq > 1
+                                      # and stage == 1; ignored (with a
+                                      # warning) otherwise
+    seq_parallel_chunk: int = 0       # tokens per seq-parallel prefill
+                                      # dispatch (rounded up to a
+                                      # multiple of the seq degree N).
+                                      # 0 = auto: N * prefill_chunk —
+                                      # each shard chews a prefill_chunk
+                                      # worth of work per dispatch
     page_size: int = 16               # paged-KV tokens per block
     num_pages: int = 0                # 0 => derive from max_batch/max_seq
     scheduler: str = "continuous"     # "continuous" (chunked-prefill/decode

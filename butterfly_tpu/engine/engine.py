@@ -288,6 +288,11 @@ class InferenceEngine:
         masked out of every decode step (prefill needs no mask: pads sit
         at positions causality already excludes).
 
+        runtime.kv_quant="int8" rides straight through (ISSUE 20): the
+        sharded prefix and the replicated suffix both hold codes+scales
+        and every attention read dequantizes in-kernel, so the long
+        prefix costs a quarter of the bf16 HBM.
+
         CLI surface: `butterfly generate --seq-parallel N`.
         """
         sp = sp or SamplingParams()
@@ -295,11 +300,11 @@ class InferenceEngine:
             raise ValueError(
                 "generate_long needs a mesh with a seq axis > 1 "
                 "(CLI: --seq-parallel N)")
-        if self.runtime.kv_quant != "none":
+        if self.mesh.shape.get("stage", 1) > 1:
             raise NotImplementedError(
-                "kv_quant does not compose with sequence parallelism yet "
-                "(sp_forward keeps the sharded prefix in the compute "
-                "dtype)")
+                "seq-parallel generation does not compose with pipeline "
+                "stages (stage > 1): sp_forward runs the whole layer "
+                "stack on every seq shard")
         from butterfly_tpu.models.common import init_cache
         from butterfly_tpu.parallel.sequence import (sp_decode_step,
                                                      sp_forward)
@@ -321,22 +326,26 @@ class InferenceEngine:
         key, first_key, loop_key = jax.random.split(
             jax.random.PRNGKey(seed), 3)
         mesh = self.mesh
-        # jit wrappers cached per engine (keyed by impl): rebuilding them
-        # per call would re-trace and recompile both programs each time
+        kvq = self.runtime.kv_quant
+        # jit wrappers cached per engine (keyed by impl + kv_quant):
+        # rebuilding them per call would re-trace and recompile both
+        # programs each time
         if not hasattr(self, "_sp_programs"):
             self._sp_programs = {}
-        if impl not in self._sp_programs:
-            self._sp_programs[impl] = (
+        if (impl, kvq) not in self._sp_programs:
+            self._sp_programs[(impl, kvq)] = (
                 jax.jit(lambda p, t: sp_forward(p, self.cfg, t, mesh,
-                                                impl=impl)),
+                                                impl=impl, kv_quant=kvq)),
                 jax.jit(lambda p, t, pos, pre, suf, pl: sp_decode_step(
                     p, self.cfg, t, pos, pre, suf, mesh, prefix_len=pl)))
-        prefill, step = self._sp_programs[impl]
+        prefill, step = self._sp_programs[(impl, kvq)]
         with self._mesh_ctx():
             logits, prefix = prefill(self.params, jnp.asarray(tokens))
             cur = sample(logits[:, true_len - 1, :], first_key, sp)
             # replicated suffix cache sized for the whole decode run
-            suffix = init_cache(self.cfg, 1, sp.max_new_tokens)
+            # (quantized alongside the prefix so both segments read the
+            # same representation the dense int8 path reads back)
+            suffix = init_cache(self.cfg, 1, sp.max_new_tokens, quant=kvq)
             # Dispatch-ahead decode: keep up to runtime.inflight_blocks
             # sp_decode_step dispatches chained on the DEVICE token
             # before reading any back — the per-token int(np.asarray)
@@ -509,9 +518,8 @@ class InferenceEngine:
         return self._verify_cache[cache_key]
 
     def _mesh_ctx(self):
-        import contextlib
-        return jax.set_mesh(self.mesh) if self.mesh is not None \
-            else contextlib.nullcontext()
+        from butterfly_tpu.core import compat
+        return compat.mesh_ctx(self.mesh)
 
 
 # ---------------------------------------------------------------------------

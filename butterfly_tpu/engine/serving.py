@@ -344,6 +344,10 @@ class ServingEngine:
         self._mixed_win_blocks: Dict[Tuple[int, int], object] = {}
         self._mixed_spec_blocks: Dict[int, object] = {}
         self._mixed_spec_win_blocks: Dict[int, object] = {}
+        # Seq-parallel chunk-prefill programs (ISSUE 20 move 3), one per
+        # bucketed chunk width C — the long-prompt admission lane
+        # (sched RuntimeConfig.seq_parallel_threshold) dispatches these.
+        self._sp_chunk_progs: Dict[int, object] = {}
         self._flush = jax.jit(flush_paged_window, donate_argnums=(0, 2))
         # Fused speculative blocks (scheduler speculative mode): one
         # jitted program per round count, like _decode_blocks. The
@@ -403,9 +407,8 @@ class ServingEngine:
                 self._tree_width, self._tree_nodes = w, n
 
     def _mesh_ctx(self):
-        import contextlib
-        return jax.set_mesh(self.mesh) if self.mesh is not None \
-            else contextlib.nullcontext()
+        from butterfly_tpu.core import compat
+        return compat.mesh_ctx(self.mesh)
 
     @property
     def num_slots(self) -> int:
@@ -431,6 +434,22 @@ class ServingEngine:
         attention. The all-or-nothing freshness downgrade — a warm
         member forcing the whole dispatch dense — is gone (ISSUE 13)."""
         return not bool(self.runtime.prefill_flash_warm)
+
+    @property
+    def supports_seq_parallel(self) -> bool:
+        """Can long prompts route through the chunked seq-parallel
+        prefill lane? Needs a live mesh with a seq axis > 1 and no
+        pipeline stages (the ring body runs the WHOLE layer stack on
+        every seq shard — it has no stage-local slice to ride)."""
+        if self.mesh is None:
+            return False
+        return (self.mesh.shape.get("seq", 1) > 1
+                and self.mesh.shape.get("stage", 1) == 1)
+
+    @property
+    def sp_degree(self) -> int:
+        """Size of the seq mesh axis (1 when meshless)."""
+        return self.mesh.shape.get("seq", 1) if self.mesh is not None else 1
 
     def set_table_row(self, slot: int, pages) -> None:
         """Host allocator -> block table. The device never writes the
@@ -605,6 +624,155 @@ class ServingEngine:
                 lengths=self.cache.lengths.at[
                     np.asarray(slots, np.int32)].set(new_lens))
         return logits[:B]
+
+    # -- seq-parallel long-prompt prefill (ISSUE 20 move 3) -----------------
+
+    def _sp_chunk_prog(self, C: int):
+        """Jitted seq-parallel chunk-prefill program for bucket width C.
+
+        One program per chunk bucket (like _decode_blocks per k): gather
+        the slot's flushed pool prefix for ALL layers, run the chunk
+        seq-sharded through sp_chunk_body (ring over the fresh chunk,
+        flash-stats merge with the replicated prefix), then scatter the
+        chunk's K/V into the page pool with ONE all-layer scatter per
+        pool tensor (flush_paged_window's idiom) — so the prompt lands
+        paged, prefix-registry-visible and evictable, and decode
+        proceeds as an ordinary paged slot.
+        """
+        prog = self._sp_chunk_progs.get(C)
+        if prog is not None:
+            return prog
+        from jax.sharding import PartitionSpec as P
+
+        from butterfly_tpu.core import compat
+        from butterfly_tpu.core.mesh import replicated
+        from butterfly_tpu.parallel.sequence import sp_chunk_body
+
+        cfg, mesh = self.cfg, self.mesh
+        quant = self.cache.quantized
+        body = partial(sp_chunk_body, cfg=cfg, quant=quant)
+
+        def run(params, tokens, pools, row, start, clen):
+            kp, vp, ksp, vsp = pools
+            L, Pp, Kv, pg, H = kp.shape
+            mp = row.shape[0]
+            S = mp * pg
+            # one gather per pool tensor covers every layer's prefix
+            if quant:
+                pk = kp[:, row].transpose(0, 2, 1, 3, 4) \
+                    .reshape(L, 1, Kv, S, H)             # codes [L,1,Kv,S,H]
+                pv = vp[:, row].transpose(0, 2, 1, 3, 4) \
+                    .reshape(L, 1, Kv, S, H)
+                pks = ksp[:, row].reshape(L, mp, Kv, pg) \
+                    .transpose(0, 2, 1, 3).reshape(L, 1, Kv, S)
+                pvs = vsp[:, row].reshape(L, mp, Kv, pg) \
+                    .transpose(0, 2, 1, 3).reshape(L, 1, Kv, S)
+                pre_args = (pk, pv, pks, pvs)
+                kv_out = (P(None, None, None, "seq", None),
+                          P(None, None, None, "seq", None),
+                          P(None, None, None, "seq"),
+                          P(None, None, None, "seq"))
+            else:
+                pk = kp[:, row].transpose(0, 1, 3, 2, 4) \
+                    .reshape(L, 1, S, Kv, H)             # [L,1,S,Kv,H]
+                pv = vp[:, row].transpose(0, 1, 3, 2, 4) \
+                    .reshape(L, 1, S, Kv, H)
+                pre_args = (pk, pv)
+                kv_out = (P(None, None, "seq"), P(None, None, "seq"))
+            layers = params["layers"]
+            head = {k: v for k, v in params.items() if k != "layers"}
+            fn = compat.shard_map(
+                body, mesh,
+                in_specs=(jax.tree.map(lambda _: P(), layers),
+                          jax.tree.map(lambda _: P(), head),
+                          P(None, "seq"), P()) + tuple(
+                              P() for _ in pre_args),
+                out_specs=(P(None, "seq"), kv_out),
+                axis_names={"seq"})
+            logits, kv = fn(layers, head, tokens, start, *pre_args)
+            # flush-style all-layer scatter of the fresh chunk into the
+            # pool; pad rows (>= clen) route to the null page
+            pos = start + jnp.arange(C)                   # [C] absolute
+            valid = jnp.arange(C) < clen
+            page_idx = row[jnp.clip(pos // pg, 0, mp - 1)]
+            page_idx = jnp.where(valid & (pos < S), page_idx, Pp - 1)
+            off = pos % pg
+            if quant:
+                ck, cv, cks, cvs = kv       # [L,1,Kv,C,H] / [L,1,Kv,C]
+                kp = kp.at[:, page_idx, :, off].set(
+                    ck[:, 0].transpose(2, 0, 1, 3))       # [C,L,Kv,H]
+                vp = vp.at[:, page_idx, :, off].set(
+                    cv[:, 0].transpose(2, 0, 1, 3))
+                # flat scale dim is kv-major: col = kv*page + offset
+                cols = jnp.arange(Kv)[None, :] * pg + off[:, None]
+                ksp = ksp.at[:, page_idx[:, None], cols].set(
+                    cks[:, 0].transpose(0, 2, 1))         # [L,C,Kv]
+                vsp = vsp.at[:, page_idx[:, None], cols].set(
+                    cvs[:, 0].transpose(0, 2, 1))
+            else:
+                ck, cv = kv                 # [L,1,C,Kv,H]
+                kp = kp.at[:, page_idx, :, off].set(
+                    ck[:, 0].transpose(1, 0, 2, 3).astype(kp.dtype))
+                vp = vp.at[:, page_idx, :, off].set(
+                    cv[:, 0].transpose(1, 0, 2, 3).astype(vp.dtype))
+            last = lax.dynamic_index_in_dim(logits[0], clen - 1, 0,
+                                            keepdims=False)
+            return last, (kp, vp, ksp, vsp)
+
+        # pin every output fully replicated EXPLICITLY (not via
+        # with_sharding_constraint inside the trace — that left the
+        # shard_map-manual layout metadata on the results): a program
+        # containing a full-manual shard_map otherwise hands back
+        # arrays whose seq-sharded provenance poisons later stacked
+        # fetches on jax 0.4.x — a drain's multi-part concatenate
+        # recompiles under the mesh and sums the seq shards, so every
+        # drained token comes back multiplied by the seq degree.
+        rep = replicated(mesh)
+        prog = jax.jit(run, donate_argnums=(2,),
+                       out_shardings=(rep, (rep, rep, rep, rep)))
+        self._sp_chunk_progs[C] = prog
+        return prog
+
+    def sp_prefill_chunk(self, slot: int, tokens: list[int],
+                         start: int) -> jax.Array:
+        """Run one seq-parallel chunk of one LONG prompt; returns the
+        chunk's last-token logits [V] (device-resident).
+
+        The scheduler's long-prompt lane (seq_parallel_threshold)
+        calls this instead of prefill_chunk when the prompt outgrows
+        what a single-device chunk program should chew: the chunk is
+        sharded over the seq axis (each shard computes C/N tokens of
+        qkv + ring attention), the already-flushed pool prefix is
+        attended via the same flash-stats merge, and the chunk's K/V
+        lands in the slot's pages — identical pool state to the dense
+        path, so prefix registry/export/eviction all apply.
+        """
+        N = self.sp_degree
+        C = bucket_len(len(tokens), hi=self.cache.max_seq)
+        C = -(-C // N) * N                  # seq axis must divide C
+        buf = np.zeros((1, C), np.int32)
+        buf[0, :len(tokens)] = tokens
+        if self._win_dirty:
+            self.flush_kv_window()
+        self._sync_table()
+        if self.tracer is not None:
+            self.tracer.event(None, "engine.sp_prefill_dispatch",
+                              slot=slot, tokens=len(tokens), bucket=C,
+                              start=start, degree=N)
+        prog = self._sp_chunk_prog(C)
+        with self._mesh_ctx():
+            pools = (self.cache.k_pages, self.cache.v_pages,
+                     self.cache.k_scale_pages, self.cache.v_scale_pages)
+            logits, pools = prog(
+                self.params, jnp.asarray(buf), pools,
+                jnp.asarray(self._host_table[slot]),
+                jnp.int32(start), jnp.int32(len(tokens)))
+            self.cache = self.cache._replace(
+                k_pages=pools[0], v_pages=pools[1],
+                k_scale_pages=pools[2], v_scale_pages=pools[3],
+                lengths=self.cache.lengths.at[slot].set(
+                    start + len(tokens)))
+        return logits
 
     def decode_active(self, tokens: np.ndarray, active: np.ndarray,
                       temps: np.ndarray, key: jax.Array

@@ -447,6 +447,139 @@ def run_warm_prefill_benchmark(model, params, *, n_requests: int = 6,
     return out
 
 
+def run_longctx_benchmark(model, params, *, prompt_len: int = 256,
+                          prefill_chunk: int = 16, max_new: int = 8,
+                          n_decoders: int = 3, decode_prompt_len: int = 16,
+                          decode_new: int = 24, page_size: int = 16,
+                          kv_quant: str = "none", repeats: int = 3,
+                          seed: int = 0) -> Dict:
+    """Long-context serving phase (ISSUE 20): one prompt spanning many
+    `prefill_chunk`s (>= 8x) admitted through the scheduler's
+    seq-parallel lane, measured two ways:
+
+    * **alone vs mixed ITL**: `n_decoders` short decode requests drained
+      with and without the long prefill running beside them. The lane
+      dispatches ONE seq-parallel chunk per tick, so the declared bound
+      is: mixed ITL p95 <= alone p95 + 1.5x one SP chunk's wall time
+      (`longctx_itl_budget_s`); `longctx_itl_within_budget` is the
+      acceptance bool the CPU smoke enforces.
+    * **ring microbench pair**: the block-stats leg production actually
+      runs (Pallas kernel on TPU, jnp twin elsewhere) vs the jnp twin,
+      same shape — `longctx_ring_block_ms` / `_jnp`. On CPU both legs
+      are the twin and `longctx_ring_kernelized: false` says so (the
+      kernel is still covered bit-exactly by the interpret-mode parity
+      grid in tests/test_longctx.py).
+
+    Requires a mesh with a seq axis: builds seq=4 x data=(devices/4)
+    when the device count allows, else reports
+    `longctx_supported: false` and returns only the microbench pair.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from butterfly_tpu.core.config import MeshConfig, RuntimeConfig
+    from butterfly_tpu.core.mesh import make_mesh
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.ops.ring_attention import block_stats
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    cfg = model.cfg
+    out: Dict = {
+        "longctx_prompt_len": prompt_len,
+        "longctx_prefill_chunk": prefill_chunk,
+        "longctx_kv_quant": kv_quant,
+    }
+
+    # -- ring microbench pair (mesh-free): one chunk's worth of queries
+    # against the full prompt's keys, the ring block's production shape
+    kernelized = jax.default_backend() == "tpu"
+    out["longctx_ring_kernelized"] = kernelized
+    rng = np.random.RandomState(seed)
+    Nq, Kv, H = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    T, S = max(8, prefill_chunk), prompt_len
+    q = jnp.asarray(rng.standard_normal((1, T, Nq, H)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, S, Kv, H)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, S, Kv, H)), jnp.float32)
+    q_pos = jnp.arange(S - T, S, dtype=jnp.int32)[None]
+    k_pos = jnp.arange(S, dtype=jnp.int32)[None]
+    for kern, suffix in ((kernelized, ""), (False, "_jnp")):
+        fn = jax.jit(functools.partial(block_stats, kernel=kern))
+        jax.block_until_ready(fn(q, k, v, q_pos, k_pos))   # compile
+        ts = []
+        for _ in range(max(3, repeats)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(q, k, v, q_pos, k_pos))
+            ts.append(time.perf_counter() - t0)
+        out["longctx_ring_block_ms" + suffix] = float(np.median(ts)) * 1e3
+
+    # -- the serving lane needs a seq axis
+    n_dev = jax.device_count()
+    if n_dev < 4 or n_dev % 4:
+        out["longctx_supported"] = False
+        return out
+    mesh = make_mesh(MeshConfig(seq=4, data=n_dev // 4))
+    rt = RuntimeConfig(max_batch_size=1 + n_decoders,
+                       max_seq_len=prompt_len + max_new + 16,
+                       page_size=page_size, kv_quant=kv_quant,
+                       prefill_chunk=prefill_chunk,
+                       seq_parallel_threshold=prompt_len // 2)
+    engine = ServingEngine(model, params, rt, mesh=mesh)
+    if not engine.supports_seq_parallel:
+        out["longctx_supported"] = False
+        return out
+    out["longctx_supported"] = True
+    V = cfg.vocab_size
+    long_prompt = rng.randint(1, V, (prompt_len,)).tolist()
+    dec_prompts = [rng.randint(1, V, (decode_prompt_len,)).tolist()
+                   for _ in range(n_decoders)]
+
+    def drain(with_long):
+        sched = Scheduler(engine)
+        lr = sched.submit(list(long_prompt), max_new_tokens=max_new,
+                          temperature=0.0) if with_long else None
+        drs = [sched.submit(list(p), max_new_tokens=decode_new)
+               for p in dec_prompts]
+        sched.run_until_done(max_ticks=10 ** 6)
+        bad = [r.id for r in drs + ([lr] if lr else [])
+               if r.state != "finished"]
+        if bad:
+            raise RuntimeError(
+                f"longctx benchmark left requests unfinished ({bad[:8]})")
+        return sched, lr
+
+    drain(False)                       # compile decoder-only widths
+    warm, _ = drain(True)              # compile SP chunk + mixed widths
+    sp_chunk = warm._sp_chunk
+    out["longctx_sp_chunk"] = sp_chunk
+
+    itl_alone, itl_mixed, ttfts, sp_toks = [], [], [], 0
+    for _ in range(repeats):
+        s, _ = drain(False)
+        itl_alone.append(s.metrics().get("itl_req_mean_p95", 0.0))
+        s, lr = drain(True)
+        itl_mixed.append(s.metrics().get("itl_req_mean_p95", 0.0))
+        ttfts.append(lr.ttft)
+        sp_toks += s._c_sp_tokens.value
+    out["longctx_sp_prefill_tokens"] = sp_toks
+    ttft50 = float(np.percentile(ttfts, 50))
+    out["longctx_ttft_p50"] = ttft50
+    out["longctx_ttft_p95"] = float(np.percentile(ttfts, 95))
+    out["longctx_prefill_tokens_per_sec"] = prompt_len / max(ttft50, 1e-9)
+    alone = float(np.median(itl_alone))
+    mixed = float(np.median(itl_mixed))
+    out["longctx_itl_p95_alone"] = alone
+    out["longctx_mixed_itl_p95"] = mixed
+    # declared bound: one SP chunk dispatch rides each tick's admit
+    # phase, so a decode gap may grow by at most ~one chunk's wall time
+    # (1.5x slack for scheduler jitter on the CPU smoke)
+    sp_chunk_s = ttft50 / max(1, -(-prompt_len // sp_chunk))
+    budget = alone + 1.5 * sp_chunk_s
+    out["longctx_itl_budget_s"] = budget
+    out["longctx_itl_within_budget"] = bool(mixed <= budget)
+    return out
+
+
 def run_spec_benchmark(model, params, *, n_requests: int = 8,
                        prompt_len: int = 32, max_new: int = 64,
                        max_batch: int = 4, gamma: int = 4, ngram: int = 2,

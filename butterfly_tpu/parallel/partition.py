@@ -263,11 +263,12 @@ def compiled_hlo(fn, *args, mesh: Optional[Mesh] = None, **jit_kw) -> str:
     """Lower+compile fn under `mesh` and return optimized HLO text."""
     jfn = jax.jit(fn, **jit_kw)
     if mesh is not None:
-        # set_mesh (not the bare context manager): it also installs the
-        # abstract mesh that mesh-aware call sites (kernel wrappers, EP
-        # a2a dispatch) consult during tracing — matching how the engines
-        # actually run.
-        with jax.set_mesh(mesh):
+        # compat.mesh_ctx resolves to set_mesh where it exists: that
+        # also installs the abstract mesh that mesh-aware call sites
+        # (kernel wrappers, EP a2a dispatch) consult during tracing —
+        # matching how the engines actually run.
+        from butterfly_tpu.core import compat
+        with compat.mesh_ctx(mesh):
             lowered = jfn.lower(*args)
     else:
         lowered = jfn.lower(*args)

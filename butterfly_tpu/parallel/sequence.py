@@ -4,14 +4,16 @@ The reference never mentions long-context mechanisms (SURVEY.md §5: absent
 from all 6 files); this realizes the survey's required surface the TPU way:
 
 * **Ring attention** (context parallel): Q/K/V are sequence-sharded over
-  the `seq` mesh axis. Each of the N ring steps computes blockwise
-  attention of the local Q chunk against the visiting K/V block, folded
-  into an online-softmax accumulator (running max / denominator — the
-  FlashAttention recurrence), then rotates K/V (+ their positions) to the
-  next neighbor with `lax.ppermute`. On TPU the ring rides neighbor ICI
-  links and XLA overlaps the permute with the block's einsums. Causality
-  comes from comparing rotated K positions to local Q positions, so any
-  chunk order works and no step is skipped (static schedule).
+  the `seq` mesh axis. Each of the N ring steps computes the visiting
+  K/V block's *partial flash statistics* — the Pallas online-softmax
+  kernel on TPU, its jnp twin elsewhere (`ops/ring_attention`, ISSUE 20;
+  the jnp leg is the jax-0.4.37/CPU fallback) — folds them into the
+  running stats with the associative merge, then rotates K/V (+ their
+  positions, + int8 scales) to the next neighbor with `lax.ppermute`.
+  On TPU the ring rides neighbor ICI links and the permute overlaps the
+  block's kernel. Causality comes from comparing rotated K positions to
+  local Q positions, so any chunk order works and no step is skipped
+  (static schedule).
 
 * **Ulysses**: `lax.all_to_all` reshards [B, T/N, H_all] -> [B, T, H/N]
   (heads scatter, sequence gathers), runs ordinary full attention on the
@@ -23,7 +25,17 @@ from all 6 files); this realizes the survey's required surface the TPU way:
   parallel), attention uses ring or Ulysses; `tensor`/`data` axes remain
   GSPMD-auto inside, so SP composes with TP. Returns logits and the
   sequence-sharded KV cache (each device keeps the K/V it computed —
-  that sharded layout IS the context-parallel cache).
+  that sharded layout IS the context-parallel cache). Under
+  `kv_quant="int8"` each device quantizes its chunk ONCE and every
+  attention read goes through codes+scales (dequant-in-kernel, the pool
+  representation) — the sharded cache comes back quantized, so a 128k
+  prefix costs a quarter of the bf16 HBM.
+
+Masking uses the sanitized-position contract of `ops/ring_attention`:
+the ONE predicate everywhere is `k_pos <= q_pos`; invalid key slots
+(prompt padding past the real length, unwritten suffix slots) carry
+position `INVALID_POS`, so causality, raggedness and padding are a
+single comparison with no per-case mask tensors.
 """
 from __future__ import annotations
 
@@ -35,66 +47,64 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from butterfly_tpu.core import compat
 from butterfly_tpu.core.config import ModelConfig
 from butterfly_tpu.models.common import (
-    KVCache, Params, attn_output, embed_tokens, ffn_block, final_logits,
-    pre_norm, qkv_proj)
+    KVCache, Params, _cast_float, attend, attn_output, embed_tokens,
+    ffn_block, final_logits, pre_norm, qkv_proj, quantize_kv,
+    update_cache_layer, update_cache_layer_q)
+from butterfly_tpu.ops.ring_attention import (
+    INVALID_POS, block_stats, finalize_stats, merge_stats, zero_stats)
 
-NEG = -1e30
 
+def ring_stats(q: jax.Array, k: jax.Array, v: jax.Array,
+               q_pos: jax.Array, k_pos: jax.Array,
+               axis_name: str = "seq",
+               k_scale: Optional[jax.Array] = None,
+               v_scale: Optional[jax.Array] = None,
+               kernel: Optional[bool] = None):
+    """Merged (unfinalized) flash stats over all N ring blocks.
 
-def _block_scores(q, k, q_pos, k_pos, scale):
-    """Masked f32 scores for one (local-Q, visiting-K) block pair.
+    The ring loop of `ring_attention` without the final normalization:
+    callers that must fold in ANOTHER key segment (the paged-pool
+    prefix of a chunked seq-parallel prefill) merge these stats with
+    that segment's before one shared `finalize_stats`.
+    """
+    B, Tq, Nq, H = q.shape
+    N = compat.axis_size(axis_name)
+    perm = [(i, (i + 1) % N) for i in range(N)]
+    stats = zero_stats(B, Nq, Tq, H)
 
-    q: [B,Tq,Kv,G,H]; k: [B,Tk,Kv,H]; positions: [B,Tq]/[B,Tk].
-    Returns [B,Kv,Tq,G,Tk]."""
-    s = jnp.einsum("btkgh,bskh->bktgs", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    causal = k_pos[:, None, :] <= q_pos[:, :, None]        # [B,Tq,Tk]
-    return jnp.where(causal[:, None, :, None, :], s, NEG)
+    def step(carry, _):
+        stats, k, v, k_pos, ks, vs = carry
+        blk = block_stats(q, k, v, q_pos, k_pos, ks, vs, kernel=kernel)
+        stats = merge_stats(stats, blk)
+        k, v, k_pos, ks, vs = lax.ppermute(
+            (k, v, k_pos, ks, vs), axis_name, perm)
+        return (stats, k, v, k_pos, ks, vs), None
+
+    (stats, _, _, _, _, _), _ = lax.scan(
+        step, (stats, k, v, k_pos, k_scale, v_scale), None, length=N)
+    return stats
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    q_pos: jax.Array, k_pos: jax.Array,
-                   axis_name: str = "seq") -> jax.Array:
+                   axis_name: str = "seq",
+                   k_scale: Optional[jax.Array] = None,
+                   v_scale: Optional[jax.Array] = None,
+                   kernel: Optional[bool] = None) -> jax.Array:
     """Causal GQA over a sequence ring (call inside shard_map).
 
-    q: [B, Tq, Nq, H] local chunk; k/v: [B, Tk, Kv, H] local chunk;
-    q_pos/k_pos: [B, T*] absolute positions. Returns [B, Tq, Nq, H].
+    q: [B, Tq, Nq, H] local chunk; float k/v: [B, Tk, Kv, H] local
+    chunk; int8 k/v: codes [B, Kv, Tk, H] with k_scale/v_scale
+    [B, Kv, Tk] (the pool representation — dequantized inside the
+    block kernel). q_pos/k_pos: [B, T*] absolute positions, invalid
+    keys sanitized to INVALID_POS. Returns [B, Tq, Nq, H].
     """
-    B, Tq, Nq, H = q.shape
-    Kv = k.shape[2]
-    G = Nq // Kv
-    N = lax.axis_size(axis_name)
-    qg = q.reshape(B, Tq, Kv, G, H)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(H, jnp.float32))
-    perm = [(i, (i + 1) % N) for i in range(N)]
-
-    # online-softmax accumulators
-    m = jnp.full((B, Kv, Tq, G), -jnp.inf, jnp.float32)
-    l = jnp.zeros((B, Kv, Tq, G), jnp.float32)
-    acc = jnp.zeros((B, Kv, Tq, G, H), jnp.float32)
-
-    def step(carry, _):
-        m, l, acc, k, v, k_pos = carry
-        s = _block_scores(qg, k, q_pos, k_pos, scale)      # [B,Kv,Tq,G,Tk]
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        # fully-masked rows keep m=-inf; guard the exp shift
-        shift = jnp.where(jnp.isinf(m_new), 0.0, m - m_new)
-        p = jnp.exp(s - jnp.where(jnp.isinf(m_new), 0.0, m_new)[..., None])
-        p = jnp.where(s <= NEG, 0.0, p)
-        corr = jnp.exp(shift)
-        l2 = l * corr + jnp.sum(p, axis=-1)
-        acc2 = acc * corr[..., None] + jnp.einsum(
-            "bktgs,bskh->bktgh", p, v.astype(jnp.float32))
-        k, v, k_pos = lax.ppermute((k, v, k_pos), axis_name, perm)
-        return (m_new, l2, acc2, k, v, k_pos), None
-
-    (m, l, acc, _, _, _), _ = lax.scan(
-        step, (m, l, acc, k, v, k_pos), None, length=N)
-    out = acc / jnp.maximum(l, 1e-30)[..., None]       # [B,Kv,Tq,G,H]
-    return out.transpose(0, 2, 1, 3, 4).reshape(B, Tq, Nq, H).astype(q.dtype)
+    return finalize_stats(
+        ring_stats(q, k, v, q_pos, k_pos, axis_name, k_scale, v_scale,
+                   kernel=kernel), q.dtype)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -108,8 +118,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block contracts with — the seq axis is no longer capped at Kv, at
     the cost of r x the K/V all_to_all volume. Returns [B, T/N, Nq, H].
     """
-    from butterfly_tpu.models.common import attend
-    N = lax.axis_size(axis_name)
+    N = compat.axis_size(axis_name)
     B, Tl, Nq, H = q.shape
     Kv = k.shape[2]
     if Kv % N != 0:
@@ -141,32 +150,51 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 
 def sp_forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
-               mesh: Mesh, impl: str = "ring"
+               mesh: Mesh, impl: str = "ring", kv_quant: str = "none"
                ) -> Tuple[jax.Array, KVCache]:
     """Long-context prefill with activations sharded over `seq`.
 
     tokens: [B, T] (T divisible by the seq axis). Returns
-    (logits [B,T,V] seq-sharded on T, KVCache with S = T seq-sharded).
+    (logits [B,T,V] seq-sharded on T, KVCache with S = T seq-sharded —
+    int8 codes+scales when kv_quant="int8", sharded over the S dim of
+    the kv-major layout).
     """
     N = mesh.shape["seq"]
     B, T = tokens.shape
     if T % N != 0:
         raise ValueError(f"seq len {T} not divisible by seq axis {N}")
+    if kv_quant not in ("none", "int8"):
+        raise ValueError(f"unknown kv quant {kv_quant!r}")
+    quant = kv_quant == "int8"
 
-    body = partial(_sp_body, cfg=cfg, impl=impl)
+    body = partial(_sp_body, cfg=cfg, impl=impl, quant=quant)
     layer_in = jax.tree.map(lambda _: P(), params["layers"])
     head_in = jax.tree.map(lambda _: P(), {
         k: v for k, v in params.items() if k != "layers"})
-    fn = jax.shard_map(
-        body, mesh=mesh,
+    if quant:
+        cache_out = (P(None, None, None, "seq", None),   # codes [L,B,Kv,T,H]
+                     P(None, None, None, "seq", None),
+                     P(None, None, None, "seq"),         # scales [L,B,Kv,T]
+                     P(None, None, None, "seq"))
+    else:
+        cache_out = (P(None, None, "seq"),               # [L,B,T,Kv,H]
+                     P(None, None, "seq"))
+    fn = compat.shard_map(
+        body, mesh,
         in_specs=(layer_in, head_in, P(None, "seq")),
-        out_specs=(P(None, "seq"), P(None, None, "seq")),
-        axis_names={"seq"}, check_vma=False)
-    logits, (ks, vs) = fn(params["layers"],
-                          {k: v for k, v in params.items() if k != "layers"},
-                          tokens)
-    cache = KVCache(k=ks, v=vs,
-                    length=jnp.full((B,), T, jnp.int32))
+        out_specs=(P(None, "seq"), cache_out),
+        axis_names={"seq"})
+    logits, cache_parts = fn(params["layers"],
+                             {k: v for k, v in params.items()
+                              if k != "layers"},
+                             tokens)
+    length = jnp.full((B,), T, jnp.int32)
+    if quant:
+        ks, vs, ksc, vsc = cache_parts
+        cache = KVCache(k=ks, v=vs, length=length, k_scale=ksc, v_scale=vsc)
+    else:
+        ks, vs = cache_parts
+        cache = KVCache(k=ks, v=vs, length=length)
     return logits, cache
 
 
@@ -180,10 +208,14 @@ def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
     The long prefix stays sharded over `seq` exactly where prefill left it
     (never regathered); generated tokens live in a small replicated
     contiguous `suffix` cache. Attention is computed as one online-softmax
-    merge (ring_attention's accumulator algebra): each device attends its
+    merge (the `ops/ring_attention` stats algebra): each device attends its
     local prefix chunk into partial (m, l, acc), the partials merge across
     the ring with pmax/psum — collectives sized [B,Nq,H], never [B,T,*] —
-    and the suffix block folds in locally.
+    and the suffix block folds in locally via the same `merge_stats`.
+
+    int8: when `prefix.quantized`, the suffix cache must be quantized too
+    (init_cache(..., quant="int8")) — both segments then read codes +
+    scales exactly like the dense int8 reference reads its cache back.
 
     tokens/positions: [B,1] (positions = prefix length + step).
     Returns (last-token logits [B,V], suffix cache with the new K/V).
@@ -205,102 +237,188 @@ def sp_decode_step(params: Params, cfg: ModelConfig, tokens: jax.Array,
                 "init_cache(max_seq=...) for the whole decode run")
     if prefix_len is None:
         prefix_len = prefix.length
-    body = partial(_sp_decode_body, cfg=cfg)
+    quant = prefix.quantized
+    if quant != suffix.quantized:
+        raise ValueError("prefix and suffix caches must agree on kv_quant")
+    body = partial(_sp_decode_body, cfg=cfg, quant=quant)
     layer_in = jax.tree.map(lambda _: P(), params["layers"])
     head = {k: v for k, v in params.items() if k != "layers"}
     head_in = jax.tree.map(lambda _: P(), head)
-    seq_kv = P(None, None, "seq")  # [L,B,T,Kv,H]: local T chunk per device
-    fn = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(layer_in, head_in, P(), P(), seq_kv, seq_kv,
-                  P(), P(), P(), P()),
-        out_specs=(P(), P(), P()),
-        axis_names={"seq"}, check_vma=False)
-    logits, new_sk, new_sv = fn(params["layers"], head, tokens, positions,
-                                prefix.k, prefix.v, suffix.k, suffix.v,
-                                suffix.length, prefix_len)
-    return logits, KVCache(new_sk, new_sv, suffix.length + 1)
+    if quant:
+        seq_kv = P(None, None, None, "seq", None)  # codes [L,B,Kv,T,H]
+        seq_sc = P(None, None, None, "seq")        # scales [L,B,Kv,T]
+        cache_args = (prefix.k, prefix.v, prefix.k_scale, prefix.v_scale,
+                      suffix.k, suffix.v, suffix.k_scale, suffix.v_scale)
+        cache_in = (seq_kv, seq_kv, seq_sc, seq_sc, P(), P(), P(), P())
+        out_specs = (P(), P(), P(), P(), P())
+    else:
+        seq_kv = P(None, None, "seq")   # [L,B,T,Kv,H]: local T chunk
+        cache_args = (prefix.k, prefix.v, suffix.k, suffix.v)
+        cache_in = (seq_kv, seq_kv, P(), P())
+        out_specs = (P(), P(), P())
+    fn = compat.shard_map(
+        body, mesh,
+        in_specs=(layer_in, head_in, P(), P()) + cache_in + (P(), P()),
+        out_specs=out_specs,
+        axis_names={"seq"})
+    out = fn(params["layers"], head, tokens, positions, *cache_args,
+             suffix.length, prefix_len)
+    if quant:
+        logits, sk, sv, sks, svs = out
+        new_suffix = KVCache(sk, sv, suffix.length + 1,
+                             k_scale=sks, v_scale=svs)
+    else:
+        logits, sk, sv = out
+        new_suffix = KVCache(sk, sv, suffix.length + 1)
+    return logits, new_suffix
 
 
-def _sp_decode_body(layers, head, tokens, positions, pk, pv, sck, scv, slen,
-                    plen, *, cfg: ModelConfig):
+def _sp_decode_body(layers, head, tokens, positions, *rest,
+                    cfg: ModelConfig, quant: bool):
     """Per-device decode step (inside shard_map, manual over seq)."""
-    from butterfly_tpu.models.common import update_cache_layer
+    if quant:
+        pk, pv, pks, pvs, sck, scv, scks, scvs, slen, plen = rest
+    else:
+        pk, pv, sck, scv, slen, plen = rest
+        pks = pvs = scks = scvs = None
 
     B = tokens.shape[0]
-    Smax = sck.shape[2]
+    Smax = sck.shape[3] if quant else sck.shape[2]
+    Tl = pk.shape[3] if quant else pk.shape[2]
     x, cos, sin = embed_tokens(head, cfg, tokens, positions)
     compute_dtype = jnp.dtype(cfg.dtype)
-    H = cfg.head_dim
-    Kv = cfg.num_kv_heads
-    G = cfg.num_heads // Kv
-    scale = 1.0 / jnp.sqrt(jnp.asarray(H, jnp.float32))
-    # suffix causal mask: slots 0..slen (inclusive of the token written
-    # this step) are visible; everything prefix-side is older than the
-    # query by construction, so the prefix needs no mask at all.
+    # sanitized key positions, built ONCE outside the layer scan:
+    # suffix slot j holds the token written at global position plen + j;
+    # slots past slen (this step's write is slot slen itself, visible)
+    # and prefix pad slots (generate_long's divisibility padding) are
+    # INVALID_POS, so the kernels' single k_pos <= q_pos comparison is
+    # the whole mask.
     j = jnp.arange(Smax)
-    suf_mask = j[None, :] <= slen[:, None]                   # [B,Smax]
-    # local prefix-chunk mask: global slot index < the row's REAL prefix
-    # length (pad K/V past it — generate_long's divisibility padding —
-    # must contribute nothing)
+    suf_pos = jnp.where(j[None, :] <= slen[:, None],
+                        plen[:, None] + j[None, :], INVALID_POS)  # [B,Smax]
     idx = lax.axis_index("seq")
-    Tl = pk.shape[2]
-    gpos = idx * Tl + jnp.arange(Tl)                         # [Tl] global
-    pre_mask = gpos[None, :] < plen[:, None]                 # [B,Tl]
+    gpos = idx * Tl + jnp.arange(Tl)                              # [Tl]
+    pre_pos = jnp.where(gpos[None, :] < plen[:, None],
+                        gpos[None, :], INVALID_POS)               # [B,Tl]
 
     def layer(x, scanned):
-        lp, pkl, pvl, ck, cv = scanned
-        from butterfly_tpu.models.common import _cast_float
+        if quant:
+            lp, pkl, pvl, pksl, pvsl, ck, cv, cks, cvs = scanned
+        else:
+            lp, pkl, pvl, ck, cv = scanned
+            pksl = pvsl = cks = cvs = None
         lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
         h = pre_norm(x, lp["ln1"], cfg)
         q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)     # q [B,1,Nq,H]
-        ck, cv = update_cache_layer(ck, cv, k, v, slen)
-        qg = q.reshape(B, 1, Kv, G, H)
+        if quant:
+            ck, cv, cks, cvs = update_cache_layer_q(ck, cv, cks, cvs,
+                                                    k, v, slen)
+        else:
+            ck, cv = update_cache_layer(ck, cv, k, v, slen)
 
-        # local prefix chunk -> partial online-softmax accumulators
-        s_p = jnp.einsum("btkgh,bskh->bktgs", qg, pkl,
-                         preferred_element_type=jnp.float32) * scale
-        s_p = jnp.where(pre_mask[:, None, None, None, :], s_p, NEG)
-        m_i = jnp.max(s_p, axis=-1)                          # [B,Kv,1,G]
-        p_i = jnp.exp(s_p - m_i[..., None])
-        p_i = jnp.where(s_p <= NEG, 0.0, p_i)
-        l_i = jnp.sum(p_i, axis=-1)
-        acc_i = jnp.einsum("bktgs,bskh->bktgh", p_i,
-                           pvl.astype(jnp.float32))
-        # merge partials across the seq ring (tiny collectives: [B,Kv,G,*])
+        # local prefix chunk -> partial flash stats (Pallas kernel on
+        # TPU, jnp twin elsewhere), merged across the seq ring with
+        # tiny collectives: [B,Nq,*], never [B,T,*]
+        m_i, l_i, acc_i = block_stats(q, pkl, pvl, positions, pre_pos,
+                                      pksl, pvsl)
         m_g = lax.pmax(m_i, "seq")
         corr = jnp.exp(m_i - m_g)
         l_g = lax.psum(l_i * corr, "seq")
         acc_g = lax.psum(acc_i * corr[..., None], "seq")
 
-        # suffix block (replicated): masked scores + merge with prefix
-        s_s = jnp.einsum("btkgh,bskh->bktgs", qg,
-                         ck.astype(compute_dtype),
-                         preferred_element_type=jnp.float32) * scale
-        s_s = jnp.where(suf_mask[:, None, None, None, :], s_s, NEG)
-        m_s = jnp.max(s_s, axis=-1)
-        p_s = jnp.exp(s_s - m_s[..., None])
-        p_s = jnp.where(s_s <= NEG, 0.0, p_s)
-        l_s = jnp.sum(p_s, axis=-1)
-        acc_s = jnp.einsum("bktgs,bskh->bktgh", p_s,
-                           cv.astype(jnp.float32))
-
-        m_f = jnp.maximum(m_g, m_s)
-        c_g, c_s = jnp.exp(m_g - m_f), jnp.exp(m_s - m_f)
-        denom = l_g * c_g + l_s * c_s
-        out = (acc_g * c_g[..., None] + acc_s * c_s[..., None]) \
-            / jnp.maximum(denom, 1e-30)[..., None]
-        out = out.transpose(0, 2, 1, 3, 4).reshape(B, 1, Kv * G, H)
-        x = x + attn_output(out.astype(x.dtype), lp["attn"], cfg)
+        # suffix block (replicated): same stats helper, local merge
+        suf = block_stats(q, ck, cv, positions, suf_pos, cks, cvs)
+        out = finalize_stats(merge_stats((m_g, l_g, acc_g), suf), x.dtype)
+        x = x + attn_output(out, lp["attn"], cfg)
         x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        if quant:
+            return x, (ck, cv, cks, cvs)
         return x, (ck, cv)
 
-    x, (new_sk, new_sv) = lax.scan(layer, x, (layers, pk, pv, sck, scv))
+    if quant:
+        xs = (layers, pk, pv, pks, pvs, sck, scv, scks, scvs)
+    else:
+        xs = (layers, pk, pv, sck, scv)
+    x, new_suffix = lax.scan(layer, x, xs)
     logits = final_logits(head, cfg, x)
-    return logits[:, -1, :], new_sk, new_sv
+    return (logits[:, -1, :],) + new_suffix
 
 
-def _sp_body(layers, head, tokens, *, cfg: ModelConfig, impl: str):
+def sp_chunk_body(layers, head, tokens, start, *rest, cfg: ModelConfig,
+                  quant: bool):
+    """Per-device slice of ONE paged long-prompt prefill chunk (inside
+    shard_map, manual over `seq`) — the serving-path sibling of
+    `_sp_body` (ISSUE 20 move 3).
+
+    tokens: local [B=1, Cl] slice of the (padded) chunk buffer whose
+    first token sits at absolute position `start` (scalar — also the
+    count of already-flushed pool-prefix tokens). `rest` is the slot's
+    REPLICATED gathered pool prefix: (pk, pv) [L,B,S,Kv,H] when float,
+    (pk, pv, pks, pvs) codes [L,B,Kv,S,H] + scales [L,B,Kv,S] when the
+    pool is int8. Each query attends that prefix locally (replicated →
+    plain block_stats, no collective) and the fresh chunk via the seq
+    ring; the two partials share one finalize. Chunk padding needs no
+    sanitization — pad positions exceed every real query's, so the
+    kernels' k_pos <= q_pos drops them — and the pad K/V rows are
+    routed to the null page by the caller's scatter. Returns
+    (logits [B,Cl,V], per-layer fresh-chunk K/V in pool
+    representation: int8 codes+scales when quant, compute-dtype floats
+    otherwise).
+    """
+    if quant:
+        pk, pv, pks, pvs = rest
+    else:
+        pk, pv = rest
+        pks = pvs = None
+    B, Cl = tokens.shape
+    S = pk.shape[3] if quant else pk.shape[2]
+    idx = lax.axis_index("seq")
+    positions = start + idx * Cl + jnp.arange(Cl)[None, :] + jnp.zeros(
+        (B, 1), jnp.int32)                                   # [B,Cl] global
+    x, cos, sin = embed_tokens(head, cfg, tokens, positions)
+    compute_dtype = jnp.dtype(cfg.dtype)
+    # sanitized prefix key positions: exactly the flushed tokens
+    # (< start) are attendable; null-page slots and the unwritten tail
+    # go to INVALID_POS (built ONCE outside the layer scan)
+    gpos = jnp.arange(S)[None, :]
+    pre_pos = jnp.broadcast_to(
+        jnp.where(gpos < start, gpos, INVALID_POS), (B, S))  # [B,S]
+
+    def layer(x, scanned):
+        if quant:
+            lp, pkl, pvl, pksl, pvsl = scanned
+        else:
+            lp, pkl, pvl = scanned
+            pksl = pvsl = None
+        lp = jax.tree.map(lambda a: _cast_float(a, compute_dtype), lp)
+        h = pre_norm(x, lp["ln1"], cfg)
+        q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
+        pre = block_stats(q, pkl, pvl, positions, pre_pos, pksl, pvsl)
+        if quant:
+            # quantize the local chunk ONCE (the pool representation);
+            # fresh-chunk reads go through codes+scales like the dense
+            # int8 reference reading its just-written pool back
+            kq, ks = quantize_kv(jnp.moveaxis(k, 2, 1))      # [B,Kv,Cl,H]
+            vq, vs = quantize_kv(jnp.moveaxis(v, 2, 1))
+            fresh = ring_stats(q, kq, vq, positions, positions,
+                               k_scale=ks, v_scale=vs)
+            kv_out = (kq, vq, ks, vs)
+        else:
+            fresh = ring_stats(q, k, v, positions, positions)
+            kv_out = (k.astype(compute_dtype), v.astype(compute_dtype))
+        out = finalize_stats(merge_stats(pre, fresh), x.dtype)
+        x = x + attn_output(out, lp["attn"], cfg)
+        x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
+        return x, kv_out
+
+    xs = (layers, pk, pv, pks, pvs) if quant else (layers, pk, pv)
+    x, kv = lax.scan(layer, x, xs)
+    logits = final_logits(head, cfg, x)
+    return logits, kv
+
+
+def _sp_body(layers, head, tokens, *, cfg: ModelConfig, impl: str,
+             quant: bool):
     """Per-device chunk of the model (inside shard_map, manual over seq)."""
     idx = lax.axis_index("seq")
     B, Tl = tokens.shape
@@ -313,14 +431,36 @@ def _sp_body(layers, head, tokens, *, cfg: ModelConfig, impl: str):
         lp = jax.tree.map(lambda a: a.astype(compute_dtype), lp)
         h = pre_norm(x, lp["ln1"], cfg)
         q, k, v = qkv_proj(h, lp["attn"], cfg, cos, sin)
-        if impl == "ring":
-            out = ring_attention(q, k, v, positions, positions)
+        if quant:
+            # quantize the local chunk ONCE (the representation the
+            # sharded cache keeps); every attention read then goes
+            # through codes+scales, matching what the dense int8
+            # reference reads back from its just-written cache.
+            kq, ks = quantize_kv(jnp.moveaxis(k, 2, 1))      # [B,Kv,Tl,H]
+            vq, vs = quantize_kv(jnp.moveaxis(v, 2, 1))
+            if impl == "ring":
+                out = ring_attention(q, kq, vq, positions, positions,
+                                     k_scale=ks, v_scale=vs)
+            else:
+                # ulysses gathers full sequences for dense attend; feed
+                # it the dequantized values (same operand set, no
+                # scale-plumbing through the all_to_alls)
+                kf = jnp.moveaxis(kq.astype(jnp.float32) * ks[..., None],
+                                  1, 2).astype(compute_dtype)
+                vf = jnp.moveaxis(vq.astype(jnp.float32) * vs[..., None],
+                                  1, 2).astype(compute_dtype)
+                out = ulysses_attention(q, kf, vf, positions)
+            kv_out = (kq, vq, ks, vs)
         else:
-            out = ulysses_attention(q, k, v, positions)
+            if impl == "ring":
+                out = ring_attention(q, k, v, positions, positions)
+            else:
+                out = ulysses_attention(q, k, v, positions)
+            kv_out = (k.astype(compute_dtype), v.astype(compute_dtype))
         x = x + attn_output(out, lp["attn"], cfg)
         x = x + ffn_block(pre_norm(x, lp["ln2"], cfg), lp, cfg)
-        return x, (k.astype(compute_dtype), v.astype(compute_dtype))
+        return x, kv_out
 
-    x, (ks, vs) = lax.scan(layer, x, layers)
+    x, kv = lax.scan(layer, x, layers)
     logits = final_logits(head, cfg, x)
-    return logits, (ks, vs)
+    return logits, kv

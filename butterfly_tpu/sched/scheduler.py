@@ -238,6 +238,30 @@ class Scheduler:
         # arrivals no longer serializes one [1, Tbucket] dispatch per
         # prompt while decode slots sit idle.
         self._prefill_group: List[Request] = []
+        # Long-prompt seq-parallel lane (ISSUE 20): prompts longer than
+        # RuntimeConfig.seq_parallel_threshold prefill through chunked
+        # seq-parallel dispatches (engine.sp_prefill_chunk — ring
+        # attention over the mesh's seq axis, K/V landing in the
+        # ordinary page pool) and then decode as normal paged slots. At
+        # most ONE request occupies the lane: each chunk dispatch
+        # already spans every seq-axis device, so a second concurrent
+        # long prefill would only queue behind the first's dispatches.
+        self._sp_group: List[Request] = []
+        self._sp_enabled = (rt.seq_parallel_threshold > 0
+                            and engine.supports_seq_parallel)
+        if rt.seq_parallel_threshold > 0 and not self._sp_enabled:
+            import warnings
+            warnings.warn(
+                "seq_parallel_threshold set but the engine cannot "
+                "seq-parallel (needs a mesh with seq > 1 and stage == "
+                "1); long prompts take the single-device chunk path",
+                RuntimeWarning, stacklevel=2)
+        # tokens per seq-parallel dispatch: each shard chews about a
+        # prefill_chunk worth of work, so one lane dispatch costs a
+        # tick roughly what a dense prefill round does
+        N = engine.sp_degree
+        spc = rt.seq_parallel_chunk or N * max(1, rt.prefill_chunk)
+        self._sp_chunk = -(-spc // max(1, N)) * max(1, N)
         self.slots: List[Optional[Request]] = [None] * engine.num_slots
         self._ids = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -396,6 +420,12 @@ class Scheduler:
             "prefill_tokens",
             "Prompt tokens prefilled per admission (prefix-cache hits "
             "excluded)", TOKEN_BUCKETS)
+        self._c_sp_tokens = reg.counter(
+            "seq_parallel_prefill_tokens_total",
+            "Prompt tokens prefilled through the long-prompt "
+            "seq-parallel lane (chunked ring-attention dispatches; "
+            "zero when seq_parallel_threshold is off or no prompt "
+            "exceeded it)")
         self._h_prefill_batch = reg.histogram(
             "prefill_batch_size",
             "Requests packed into one batched [B, Tbucket] prefill "
@@ -629,6 +659,10 @@ class Scheduler:
         backlog = prompt_len
         backlog += sum(len(r.all_tokens) - r.prefilled
                        for r in self._prefill_group)
+        # seq-parallel lane work is shared N ways across the mesh
+        backlog += sum(len(r.all_tokens) - r.prefilled
+                       for r in self._sp_group) \
+            // max(1, self.engine.sp_degree)
         backlog += sum(len(r.all_tokens) for r in self.waiting)
         rounds = -(-backlog // chunk) + len(self.waiting)
         return rounds * tick_s
@@ -706,7 +740,8 @@ class Scheduler:
 
     @property
     def _all_live(self) -> List[Request]:
-        return list(self.running) + list(self._prefill_group)
+        return (list(self.running) + list(self._prefill_group)
+                + list(self._sp_group))
 
     def unfinished_requests(self) -> List[Request]:
         """Every request that would be lost in a crash: running,
@@ -749,10 +784,12 @@ class Scheduler:
         self.running.clear()
         self.waiting.clear()
         self._prefill_group.clear()
+        self._sp_group.clear()
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running or self._prefill_group)
+        return bool(self.waiting or self.running or self._prefill_group
+                    or self._sp_group)
 
     def run_until_done(self, max_ticks: int = 100000) -> None:
         for _ in range(max_ticks):
@@ -826,6 +863,18 @@ class Scheduler:
             if self._drain_oldest():
                 self._drain_inflight("finish")
         mixed = self._mixed_mode
+        # seq-parallel long-prompt lane (ISSUE 20): at most one chunk
+        # per tick — the lane's dispatch donates the pool binding, so
+        # _sp_prefill_step drains in-flight blocks itself. The chunk's
+        # per-device share counts against this tick's prefill budget
+        # below (decode-ITL interference stays bounded by the declared
+        # prefill_inline_budget just like ordinary chunked prefill).
+        sp_used = 0
+        if self._sp_enabled:
+            t_sp = time.monotonic()
+            self._sp_admit()
+            sp_used = self._sp_prefill_step()
+            self._phase_add("admit", time.monotonic() - t_sp)
         # admission barrier — retired as a class under mixed dispatch,
         # where admission is a host-side carry edit between dispatches
         # (_admit_inline) and the prompt rides the next fused block.
@@ -840,7 +889,7 @@ class Scheduler:
         if mixed:
             self._admit_inline()
         else:
-            self._admit()
+            self._admit(sp_used // max(1, self.engine.sp_degree))
         self._phase_add("admit", time.monotonic() - t_admit)
         if self.running:
             self._h_batch.observe(len(self.running))
@@ -1134,6 +1183,9 @@ class Scheduler:
             # that the admission barrier class is retired
             m["mixed_dispatch_prefill_tokens_inline"] = \
                 float(self._inline_pf_tokens)
+        if self._sp_enabled:
+            m["seq_parallel_prefill_tokens_total"] = \
+                self._c_sp_tokens.value
         return m
 
     def barrier_causes(self) -> Dict[str, float]:
@@ -1205,11 +1257,103 @@ class Scheduler:
                 return i
         return None
 
-    def _admit(self) -> None:
+    def _sp_qualifies(self, req: Request) -> bool:
+        """Does this prompt belong to the seq-parallel long-prompt
+        lane? (The normal admission loops break on a qualifying head
+        so the lane keeps FCFS order — a long prompt waits for the
+        lane, it never falls back to a single-device prefill.)"""
+        return (self._sp_enabled and len(req.all_tokens)
+                > self.engine.runtime.seq_parallel_threshold)
+
+    def _sp_admit(self) -> None:
+        """Admit the head-of-queue request into the seq-parallel lane
+        when it qualifies and the lane is empty: pages for the WHOLE
+        prompt (+1 for the first decode token) are allocated up front —
+        every chunk scatters straight into the pool, so there is no
+        later growth point mid-prefill."""
+        if not self._sp_enabled or self._sp_group or not self.waiting:
+            return
+        req = self.waiting[0]
+        if not self._sp_qualifies(req):
+            return
+        slot = self._free_slot()
+        if slot is None:
+            return
+        if self._shares_inflight_prefix(req):
+            return  # defer: a gang member is writing req's prefix
+        cached = self.alloc.admit(slot, req.all_tokens,
+                                  len(req.all_tokens) + 1)
+        if cached is None:
+            return  # pool exhausted; decode will free/preempt
+        self.waiting.popleft()
+        req.slot, req.state = slot, "prefilling"
+        req.prefilled = req.cached_at_admit = cached
+        self.slots[slot] = req
+        self._sp_group.append(req)
+        self.engine.set_table_row(slot, self.alloc.pages_of(slot))
+        self._epoch += 1  # membership changed: operands rebuild
+        wait = time.monotonic() - req.t_enqueued
+        self._h_queue_wait.observe(wait)
+        if self.flightrec is not None:
+            self.flightrec.note("admit", id=req.id, slot=slot,
+                                queue_wait_s=wait, cached=cached,
+                                seq_parallel=True)
+        if self.trace is not None:
+            self.trace.event(req.id, "admit", slot=slot,
+                             queue_wait_s=wait,
+                             prefix_cache_hit_tokens=cached,
+                             resumed=req.preemptions > 0,
+                             seq_parallel=True)
+
+    def _sp_prefill_step(self) -> int:
+        """Dispatch ONE seq-parallel prefill chunk for the lane's
+        request (engine.sp_prefill_chunk). Returns the prompt tokens
+        dispatched (0 = lane empty or blocked).
+
+        The chunk program donates the newest pool binding, so any
+        in-flight decode blocks drain first — the established donation
+        barrier (same hazard as admission prefills on the alternating
+        path). On completion the request leaves through
+        _finish_prefill like any gang member: pages publish to the
+        prefix registry and the first token samples from the chunk's
+        last-position logits."""
+        if not self._sp_group:
+            return 0
+        req = self._sp_group[0]
+        if self._inflight or self._pending_first:
+            self._drain_inflight("sp_prefill")
+            if req.done or req.slot is None:
+                return 0  # the drain finished or preempted it
+        toks = req.all_tokens
+        chunk = toks[req.prefilled:req.prefilled + self._sp_chunk]
+        if not chunk:
+            return 0
+        if self.trace is not None:
+            self.trace.event(req.id, "sp_prefill_chunk",
+                             start=req.prefilled, tokens=len(chunk),
+                             degree=self.engine.sp_degree)
+        logits = self.engine.sp_prefill_chunk(req.slot, chunk,
+                                              req.prefilled)
+        req.prefilled += len(chunk)
+        self._c_sp_tokens.inc(len(chunk))
+        if req.prefilled >= len(toks):
+            # logits is [V] — _finish_prefill samples from [M, V] rows
+            self._finish_prefill([req], logits[None, :])
+            # mixed carries: the slot enters decode phase (plen 0); its
+            # pool length was set by the chunk dispatches themselves
+            self._plen_host[req.slot] = 0
+        return len(chunk)
+
+    def _admit(self, sp_spent: int = 0) -> None:
         """Group admission: gang-admit waiting requests and run the
         prefill group's next chunks as batched dispatches, repeating
         while budget remains and progress is possible (a round whose
-        members all complete cheaply leaves budget for another gang)."""
+        members all complete cheaply leaves budget for another gang).
+
+        `sp_spent`: per-shard prompt tokens the seq-parallel lane
+        already dispatched this tick — it counts against the tick's
+        prefill budget so a tick never chews more than ~prefill_chunk
+        tokens per device."""
         rt = self.engine.runtime
         if rt.scheduler == "static":
             # Static batching: no interleave — admit (and fully prefill)
@@ -1219,7 +1363,9 @@ class Scheduler:
                 return
             budget = None
         else:
-            budget = max(1, rt.prefill_chunk)
+            budget = max(1, rt.prefill_chunk) - sp_spent
+            if budget <= 0:
+                return
         while True:
             used = self._admit_round(budget)
             if used is None:
@@ -1251,6 +1397,8 @@ class Scheduler:
             if slot is None:
                 break
             req = self.waiting[0]
+            if self._sp_qualifies(req):
+                break  # long prompt: waits for the seq-parallel lane
             if self._shares_inflight_prefix(req):
                 break  # defer: a gang member is writing req's prefix
             cached = self.alloc.admit(slot, req.all_tokens,
@@ -1346,6 +1494,8 @@ class Scheduler:
             if slot is None:
                 break
             req = self.waiting[0]
+            if self._sp_qualifies(req):
+                break  # long prompt: waits for the seq-parallel lane
             if self._shares_inflight_prefix(req):
                 break  # defer: a gang member is writing req's prefix
             # all_tokens includes output if preempted earlier; admit
@@ -1474,7 +1624,10 @@ class Scheduler:
         like any post-finish in-flight work)."""
         for req in reqs:
             self.alloc.register(req.slot, req.all_tokens)
-            self._prefill_group.remove(req)
+            if req in self._prefill_group:
+                self._prefill_group.remove(req)
+            else:  # the seq-parallel lane finishes through here too
+                self._sp_group.remove(req)
             req.state = "running"
             self.running.append(req)
             ran = len(req.all_tokens) - req.cached_at_admit
@@ -1589,7 +1742,11 @@ class Scheduler:
             stops = np.full((S,), -1, np.int32)
             base = np.zeros((S,), np.int32)
             specm = np.zeros((S,), bool)
-            batch = self._all_live if self._mixed_mode else self.running
+            # seq-parallel-lane members never ride a block: their
+            # prefill happens in dedicated sp_prefill_chunk dispatches
+            # and they enter `running` only via _finish_prefill.
+            batch = (list(self.running) + list(self._prefill_group)
+                     if self._mixed_mode else self.running)
             for req in batch:
                 active[req.slot] = True
                 temps[req.slot] = req.temperature
@@ -1873,9 +2030,15 @@ class Scheduler:
             parts.append(flushed.reshape(1))  # trailing; offsets unaffected
         # the ONE stacked device fetch: the only tick section that
         # blocks on the device — timed for the tick_host_frac /
-        # tick_device_frac split (everything else in a tick is host)
+        # tick_device_frac split (everything else in a tick is host).
+        # device_get issues every part's host copy async before the
+        # first blocking read, then the concat is pure host numpy — a
+        # device-side jnp.concatenate over parts with mixed shardings
+        # miscompiles under an active mesh on jax 0.4.x (a 3-part
+        # concat comes back with every element summed over the seq
+        # shards, i.e. multiplied by the seq degree).
         t_fetch = time.monotonic()
-        vals = np.asarray(jnp.concatenate(parts)) if len(parts) > 1 \
+        vals = np.concatenate(jax.device_get(parts)) if len(parts) > 1 \
             else np.asarray(parts[0])
         self._tick_fetch += time.monotonic() - t_fetch
         if flushed is not None:
@@ -2083,6 +2246,8 @@ class Scheduler:
         req.t_finish = time.monotonic()
         if req in self._prefill_group:  # cancelled mid-chunked-prefill
             self._prefill_group.remove(req)
+        if req in self._sp_group:  # cancelled mid-seq-parallel-prefill
+            self._sp_group.remove(req)
         if req.slot is not None:
             self.alloc.release(req.slot)
             self.engine.reset_slot(req.slot)
@@ -2136,7 +2301,8 @@ class Scheduler:
             # priority semantics); within a class the youngest loses —
             # so an old batch job still yields to a young interactive
             # one, but interactive never pays for batch's pages
-            victim = max(self.running + self._prefill_group,
+            victim = max(self.running + self._prefill_group
+                         + self._sp_group,
                          key=lambda r: (r.priority == "batch", r.t_arrive))
             self._preempt(victim)
             if victim is req:
@@ -2190,8 +2356,10 @@ class Scheduler:
         req.slot = None
         if req in self.running:
             self.running.remove(req)
-        else:
+        elif req in self._prefill_group:
             self._prefill_group.remove(req)
+        else:
+            self._sp_group.remove(req)
         # all_tokens (prompt + output) are recomputed on readmission
         req.state = "waiting"
         req.prefilled = 0

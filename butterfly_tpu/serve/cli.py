@@ -194,6 +194,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "load. B buckets to powers of two clamped "
                         "here, so raising it adds at most one compiled "
                         "program per prompt-length bucket")
+    s.add_argument("--seq-parallel-threshold", type=int, default=0,
+                   help="long-context admission lane: prompts LONGER "
+                        "than this many tokens prefill through chunked "
+                        "seq-parallel dispatches sharded over the "
+                        "mesh's seq axis (requires --seq-parallel > 1), "
+                        "landing their KV in the ordinary paged pool — "
+                        "prefix-cache-visible and decoded like any "
+                        "other slot. 0 (default) = off")
+    s.add_argument("--seq-parallel-chunk", type=int, default=0,
+                   help="tokens per seq-parallel prefill dispatch "
+                        "(rounded up to a multiple of the seq degree); "
+                        "0 = auto: seq degree x prefill_chunk, so the "
+                        "per-device chunk share matches the ordinary "
+                        "prefill budget and decode ITL interference "
+                        "stays within the same bound")
     def slo_flags(sp):
         sp.add_argument("--slo-ttft-ms", type=float, default=None,
                         help="declared time-to-first-token objective in "
@@ -611,12 +626,10 @@ def cmd_generate(args) -> int:
                   "--seq-parallel (the long-context path has no warm "
                   "multi-token verify)", file=sys.stderr)
             return 2
-        if args.kv_quant != "none":
-            print("error: --kv-quant does not compose with "
-                  "--seq-parallel yet", file=sys.stderr)
-            return 2
         # long-context path: sp_forward prefill + sp_decode_step loop
-        # (engine.generate_long docs)
+        # (engine.generate_long docs); --kv-quant int8 composes — the
+        # seq-parallel cache shards int8 codes + scales and the ring
+        # kernel dequantizes per block
         res = engine.generate_long(ids, sp, seed=args.seed,
                                    impl=args.seq_impl)
         dt = time.perf_counter() - t0
@@ -651,20 +664,17 @@ def cmd_generate(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    if args.seq_parallel > 1:
-        print("error: --seq-parallel applies to `generate` (long-context "
-              "single-sequence path); the serving engine shards slots over "
-              "data/tensor/stage instead", file=sys.stderr)
+    if getattr(args, "seq_parallel_threshold", 0) > 0 \
+            and args.seq_parallel <= 1:
+        print("error: --seq-parallel-threshold needs a seq axis — pass "
+              "--seq-parallel N (> 1) to shard long prompts over N "
+              "devices", file=sys.stderr)
         return 2
     from butterfly_tpu.serve.server import run_server
     return run_server(args)
 
 
 def cmd_bench(args) -> int:
-    if args.seq_parallel > 1:
-        print("error: --seq-parallel applies to `generate` (long-context "
-              "single-sequence path)", file=sys.stderr)
-        return 2
     from butterfly_tpu.obs.benchmark import (run_decode_benchmark,
                                              run_serving_benchmark)
 
@@ -688,6 +698,13 @@ def cmd_bench(args) -> int:
             isolated_decode_tok_s_chip=stats[
                 "decode_tokens_per_sec_per_chip"])
         stats.update(serving)
+        if mesh is None:
+            # long-context row (ISSUE 20): builds its own seq=4 mesh
+            # when the device count allows; on fewer devices it reports
+            # longctx_supported: false plus the ring microbench pair
+            from butterfly_tpu.obs.benchmark import run_longctx_benchmark
+            stats.update(run_longctx_benchmark(
+                model, params, kv_quant=args.kv_quant))
     if getattr(args, "mixed", False):
         # mixed-workload phase (ISSUE 10): mixed_chat open-loop bursts
         # against an under-provisioned pool + the operating-point sweep

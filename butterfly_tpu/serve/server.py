@@ -1245,7 +1245,11 @@ def run_server(args) -> int:
                        prefill_max_batch=getattr(
                            args, "prefill_max_batch", 8),
                        inflight_blocks=getattr(
-                           args, "inflight_blocks", 2))
+                           args, "inflight_blocks", 2),
+                       seq_parallel_threshold=getattr(
+                           args, "seq_parallel_threshold", 0),
+                       seq_parallel_chunk=getattr(
+                           args, "seq_parallel_chunk", 0))
     engine = ServingEngine(model, params, rt, mesh=mesh)
     # Tracing defaults ON for the serve entrypoint (/debug/requests is
     # the production debugging surface); --no-trace turns it off for

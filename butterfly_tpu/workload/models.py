@@ -340,7 +340,13 @@ def mixed_chat(*, page_size: int = 16, vocab: int = 258,
       prefill_chunk and so CHUNK across scheduler ticks, putting the
       warm-prefix prefill path (flash cached-prefix kernel vs dense
       fallback) under the mixed bench's clock — ROADMAP item 5's
-      long-doc cohort.
+      long-doc cohort. Under a seq-parallel mesh with
+      ``seq_parallel_threshold`` below prompt_hi (ISSUE 20), the
+      cohort's longest prompts additionally route through the
+      scheduler's seq-parallel prefill lane, so the mixed bench
+      exercises the lane's chunk dispatches against live decode
+      traffic (the dedicated ``longctx_*`` bench row measures that
+      interference in isolation).
 
     Prompt lengths span [prompt_lo, prompt_hi] (default 32-1024),
     decode budgets [max_new_lo, max_new_hi] — heterogeneous enough

@@ -178,6 +178,14 @@ SLOW_TESTS = {
     "test_retire_drains_without_dropping_requests",
     "test_autoscaler_closes_the_loop_on_a_live_fleet",
     "test_autoscale_benchmark_beats_static_peak",
+    # long-context SP lane (ISSUE 20): interpret-mode Pallas grid + the
+    # scheduler scenarios that compile an SP engine AND a dense twin
+    # per combo (the fast tier keeps the merge-stats algebra and ONE
+    # seq=4 int8 engine-level chunk-prefill parity anchor)
+    "test_ring_block_parity_grid",
+    "test_sp_sched_long_prefill_parity",
+    "test_prefix_hit_after_long_prefill",
+    "test_longctx_benchmark_smoke",
 }
 
 

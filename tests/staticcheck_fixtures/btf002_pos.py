@@ -1,6 +1,6 @@
 """BTF002 positive fixture: reads of donated references after dispatch.
 
-Expected findings: 7 —
+Expected findings: 8 —
 * a read of the donated cache in the statement after the dispatch,
 * the same handle re-passed on the next loop iteration without rebind,
 * a read of a tree donated to a locally-built donating jit,
@@ -16,7 +16,10 @@ Expected findings: 7 —
 * a tree-speculation dispatch (ISSUE 19: factory program donating the
   history carry, the draft KV state, AND the staged tree-KV window +
   count) that rebinds everything except the window and then reads the
-  stale tree K/V.
+  stale tree K/V,
+* a seq-parallel chunk-prefill dispatch (ISSUE 20: factory program
+  donating the paged KV pool AND the per-slot length vector) that
+  rebinds the pool but reads the donated lengths afterwards.
 """
 import jax
 
@@ -156,3 +159,30 @@ class TreeEngine:
         self._hist, self.cache = hist, cache
         self._draft_state, self._wlen = dstate, wlen
         return toks, self._window   # finding 7: tree window NOT rebound
+
+
+def _step_sp(params, chunk, cache, lengths, table):
+    return chunk, cache, lengths
+
+
+class SeqParallelEngine:
+    """The seq-parallel chunk-prefill carry (ISSUE 20): one program
+    donates the paged KV pool AND the per-slot length vector
+    (serving.py's _sp_chunk_prog shape); the chunk operand and the
+    page table are not donated."""
+
+    def __init__(self):
+        self._sp_progs = {}
+
+    def _sp_prog(self, c):
+        prog = self._sp_progs.get(c)
+        if prog is None:
+            prog = jax.jit(_step_sp, donate_argnums=(2, 3))
+            self._sp_progs[c] = prog
+        return prog
+
+    def stale_length_read(self, params, chunk, c):
+        logits, cache, lengths = self._sp_prog(c)(
+            params, chunk, self.cache, self._lengths, self._table)
+        self.cache = cache            # pool rebound...
+        return logits, self._lengths  # finding 8: lengths NOT rebound

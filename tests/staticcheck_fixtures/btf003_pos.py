@@ -6,7 +6,9 @@ the ISSUE 15 timer/ticklog paths: a ticklog record() that .tolist()s a
 device value into its entry, and a flight-recorder poll() that float()s
 a device carry into a trigger signal, plus the ISSUE 16 time-series
 paths: a recorder sample() that .item()s a gauge off the device, and an
-evaluate_rules() that float()s a device carry into a predicate.
+evaluate_rules() that float()s a device carry into a predicate, plus
+the ISSUE 20 seq-parallel lane: an sp_prefill_chunk() that np.asarray()s
+its chunk logits back to the host per dispatch.
 """
 import jax
 import numpy as np
@@ -51,3 +53,12 @@ def evaluate_rules(rules, samples):
         if float(rule.threshold_dev) < samples[-1]:        # 9: float/_dev
             return True
     return False
+
+
+class SpEngine:
+    def sp_prefill_chunk(self, slot, tokens, start):
+        # the seq-parallel lane lands one chunk per tick: fetching the
+        # chunk logits per dispatch serializes the whole long prefill
+        # behind the host (the first token samples at the drain)
+        logits = self._dispatch(slot, tokens, start)
+        return np.asarray(logits)                     # 10: asarray

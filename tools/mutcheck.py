@@ -135,9 +135,18 @@ MUTANTS = [
     # ring attention: one rotation short (each device misses one
     # neighbor's K/V block)
     ("butterfly_tpu/parallel/sequence.py",
-     "step, (m, l, acc, k, v, k_pos), None, length=N)",
-     "step, (m, l, acc, k, v, k_pos), None, length=N - 1)",
+     "step, (stats, k, v, k_pos, k_scale, v_scale), None, length=N)",
+     "step, (stats, k, v, k_pos, k_scale, v_scale), None, length=N - 1)",
      ["tests/test_sequence.py"], {}),
+    # flash-stats merge (ISSUE 20): drop the running-max correction on
+    # the a-leg — partials whose local max is below the joint max keep
+    # their unrescaled weight, so every ring rotation / SP chunk merge
+    # over-counts the smaller-max side. Killed by the four-shard merge
+    # algebra test in tests/test_longctx.py (and the ring parity grid).
+    ("butterfly_tpu/ops/ring_attention.py",
+     "c_a = jnp.exp(m_a - m)",
+     "c_a = jnp.exp(m_a - m_a)",
+     ["tests/test_longctx.py"], {}),
     # sp_decode partial-softmax merge: global max skipped (per-device
     # exp shifts disagree, denominators mis-merge)
     ("butterfly_tpu/parallel/sequence.py",

@@ -112,7 +112,24 @@ def main() -> int:
                     help="truncation depth for --draft-source model "
                          "(matches RuntimeConfig.draft_layers; 0 = "
                          "num_layers/4, floor 1)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="trace ONE seq-parallel prefill chunk dispatch "
+                         "(engine.sp_prefill_chunk: ring attention over "
+                         "the mesh's seq axis, K/V scattered into the "
+                         "paged pool) plus one fused decode block "
+                         "beside it — the ISSUE 20 scheduler lane. "
+                         "Builds a seq=4 mesh; the device count must be "
+                         "a multiple of 4 (on CPU, 8 host devices are "
+                         "forced like tests/conftest.py)")
     args = ap.parse_args()
+
+    if args.long_context:
+        # must land before the first jax import initializes the backend
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
     import jax.numpy as jnp
@@ -146,6 +163,8 @@ def main() -> int:
     params = init_params_quantized(cfg, jax.random.PRNGKey(0)) if on_tpu \
         else quantize_int8(model.init(jax.random.PRNGKey(0)), cfg)
     kv_quant = "int8" if on_tpu else "none"
+    if args.long_context:
+        return _profile_longctx(args, model, params, kv_quant)
     if args.prefill:
         return _profile_prefill_batch(args, model, params, kv_quant)
     if args.pipeline:
@@ -244,6 +263,78 @@ def _profile_serving_block(args, model, params, kv_quant: str) -> int:
     logdir = args.out or tempfile.mkdtemp(prefix="serving_block_trace_")
     jax.profiler.start_trace(logdir)
     sched._decode_block(k)
+    jax.block_until_ready(sched._inflight[-1][1])
+    jax.profiler.stop_trace()
+    sched.run_until_done(max_ticks=10 ** 6)
+    return _report(logdir, args.top)
+
+
+def _profile_longctx(args, model, params, kv_quant: str) -> int:
+    """Trace the long-context lane (ISSUE 20): one seq-parallel prefill
+    chunk dispatch (ring attention over the seq axis, K/V scattered into
+    the paged pool) plus one fused decode block beside it — the two
+    programs a tick pays while a long prompt streams through the lane.
+    Warmed end to end first (a full long prefill + decode) so both
+    programs are compiled off the clock."""
+    import jax
+    import numpy as np
+
+    from butterfly_tpu.core.config import MeshConfig, RuntimeConfig
+    from butterfly_tpu.core.mesh import make_mesh
+    from butterfly_tpu.engine.serving import ServingEngine
+    from butterfly_tpu.sched.scheduler import Scheduler
+
+    n_dev = jax.device_count()
+    if n_dev < 4 or n_dev % 4:
+        print(f"--long-context needs a device count divisible by 4 for "
+              f"the seq=4 mesh (have {n_dev})", file=sys.stderr)
+        return 1
+    mesh = make_mesh(MeshConfig(seq=4, data=n_dev // 4))
+    cfg = model.cfg
+    k = args.steps_per_tick
+    chunk = args.prompt_len            # per-shard work unit per dispatch
+    long_len = 8 * chunk               # the lane's admission regime
+    max_new = max(args.max_new, 8 * k + 16)
+    rt = RuntimeConfig(max_batch_size=args.batch,
+                       max_seq_len=long_len + max_new + 16,
+                       kv_quant=kv_quant, decode_steps_per_tick=k,
+                       prefill_chunk=chunk,
+                       seq_parallel_threshold=long_len // 2)
+    engine = ServingEngine(model, params, rt, mesh=mesh)
+    if not engine.supports_seq_parallel:
+        print("engine cannot seq-parallel on this mesh", file=sys.stderr)
+        return 1
+    sched = Scheduler(engine)
+    rng = np.random.RandomState(0)
+
+    def prompt(n):
+        return rng.randint(1, cfg.vocab_size, (n,)).tolist()
+
+    # warm: one long prefill end to end + decoders that keep decoding
+    # (compiles the SP chunk program and the k-step block off the clock)
+    warm_long = sched.submit(prompt(long_len), max_new_tokens=2)
+    for _ in range(args.batch - 1):
+        sched.submit(prompt(args.prompt_len), max_new_tokens=max_new)
+    while (sched.waiting or sched._prefill_group or sched._sp_group
+           or not warm_long.done):
+        sched.tick()
+    sched._drain_inflight()
+    # a fresh long prompt into the (now free) lane slot
+    sched.submit(prompt(long_len), max_new_tokens=2)
+    sched._sp_admit()
+    assert sched._sp_group, "long prompt did not enter the SP lane"
+    # replicate tick()'s page preallocation so the traced block pays no
+    # host-side growth
+    for req in list(sched.running):
+        if req in sched.running:
+            need = min(len(req.all_tokens) + k + 1,
+                       len(req.prompt) + req.max_new_tokens)
+            sched._ensure_or_preempt(req, need)
+    jax.block_until_ready(engine.cache.lengths)
+    logdir = args.out or tempfile.mkdtemp(prefix="longctx_trace_")
+    jax.profiler.start_trace(logdir)
+    sched._sp_prefill_step()           # ONE seq-parallel chunk dispatch
+    sched._decode_block(k)             # one fused block beside the lane
     jax.block_until_ready(sched._inflight[-1][1])
     jax.profiler.stop_trace()
     sched.run_until_done(max_ticks=10 ** 6)

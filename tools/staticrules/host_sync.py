@@ -41,6 +41,9 @@ HOT_FUNCTIONS: Set[str] = {
     "_admit_round", "_finish_prefill", "_note_bubble",
     "decode_block_async", "spec_block_async", "decode_active_async",
     "prefill_batch", "_sync_table",
+    # ISSUE 20: the seq-parallel long-prompt lane — one chunk dispatch
+    # per tick; a per-chunk readback would serialize the whole prefill
+    "_sp_prefill_step", "sp_prefill_chunk",
     "_phase_add", "_drain_accrued", "_record_tick",
     "record", "note", "poll",
     # ISSUE 16: the signal recorder samples inside _record_tick (the
